@@ -1,0 +1,83 @@
+"""Benchmark entry point (driver-run, real TPU).
+
+Workload: BASELINE.md row 1 — exhaust (or depth/time-capped sweep of) the
+reference `standard-raft/Raft.cfg` state space with the TPU checker and
+report sustained distinct-states/sec.
+
+vs_baseline: the reference publishes NO performance numbers
+(BASELINE.md: "published: {}"), and TLC (Java) is not present in this
+image, so the comparison baseline is the in-repo pure-Python oracle
+interpreter (the same role as TLC: a CPU explicit-state checker of the
+identical spec + VIEW/SYMMETRY semantics) measured on the same machine on
+a depth-capped slice of the same workload. vs_baseline = tpu_rate /
+oracle_rate.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("BENCH_TIME_BUDGET_S", "300")
+
+
+def tpu_rate() -> tuple[float, dict]:
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+    from raft_tpu.checker.bfs import BFSChecker
+
+    cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+    setup = build_from_cfg(cfg, msg_slots=32)
+    checker = BFSChecker(
+        setup.model, invariants=setup.invariants, symmetry=True, chunk=2048
+    )
+    budget = float(os.environ["BENCH_TIME_BUDGET_S"])
+    max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "0")) or None
+    t0 = time.perf_counter()
+    res = checker.run(max_depth=max_depth, time_budget_s=budget)
+    dt = time.perf_counter() - t0
+    meta = {
+        "distinct": res.distinct,
+        "depth": res.depth,
+        "exhausted": res.exhausted,
+        "seconds": round(dt, 2),
+        "violation": res.violation.invariant if res.violation else None,
+    }
+    return res.states_per_sec, meta
+
+
+def oracle_rate() -> float:
+    from raft_tpu.oracle.raft_oracle import RaftOracle
+
+    # same spec/constants as Raft.cfg, depth-capped for time
+    oracle = RaftOracle(3, 1, 2, 0)
+    t0 = time.perf_counter()
+    res = oracle.bfs(
+        invariants=("LeaderHasAllAckedValues", "NoLogDivergence"),
+        symmetry=True,
+        max_depth=int(os.environ.get("BENCH_ORACLE_DEPTH", "7")),
+    )
+    dt = time.perf_counter() - t0
+    return res["distinct"] / dt
+
+
+def main():
+    rate, meta = tpu_rate()
+    base = oracle_rate()
+    out = {
+        "metric": "distinct_states_per_sec_raft3_cfg",
+        "value": round(rate, 1),
+        "unit": "distinct states/s",
+        "vs_baseline": round(rate / base, 2) if base > 0 else None,
+        "detail": meta,
+        "baseline_kind": "in-repo python oracle checker (TLC stand-in), depth-capped",
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

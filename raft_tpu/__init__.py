@@ -1,0 +1,30 @@
+"""raft_tpu — a TPU-native explicit-state model checker for the Raft TLA+ suite.
+
+This package re-provides, TPU-first, the full model-checking capability that
+the reference repo (Vanlightly/raft-tlaplus, mounted at /root/reference)
+obtains from TLC: per-variant `Next` relations hand-lowered to vectorized JAX
+transition kernels over a packed fixed-width state encoding, BFS frontier
+expansion via `vmap`, VIEW/SYMMETRY-aware 64-bit fingerprint dedup, batched
+invariant predicates, counterexample trace reconstruction, and frontier
+sharding across a `jax.sharding.Mesh`.
+
+Layout of the package:
+  models/    per-variant spec lowerings (state layout + action kernels +
+             invariants), e.g. models/raft.py for
+             reference specifications/standard-raft/Raft.tla
+  ops/       spec-agnostic device ops: bit packing, message-bag ops,
+             symmetry canonicalization, 64-bit fingerprint hashing
+  checker/   BFS driver, dedup, trace reconstruction, simulation mode
+  parallel/  sharded-frontier expansion over a device mesh (ICI all-to-all)
+  oracle/    independent pure-Python interpreters of the TLA+ semantics,
+             used for differential testing (TLC itself is not vendored)
+  utils/     TLC `.cfg` parser, pretty printers
+"""
+
+import jax
+
+# 64-bit fingerprints (TLC uses 64-bit state fingerprints; parity requires
+# the same collision budget). Must run before any jax arrays are created.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,112 @@
+"""CLI — the `tlc` replacement.
+
+    python -m raft_tpu path/to/Raft.cfg [--checker tpu|oracle] ...
+
+Mirrors the reference workflow `tlc <Spec>.tla -config <Spec>.cfg -deadlock`
+(reference README.md:5-7): `-deadlock` semantics are the default (terminal
+states are reported, not errors). The CHECKER env var or --checker flag
+selects the backend; `oracle` is the pure-Python differential reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="raft_tpu")
+    ap.add_argument("cfg", help="TLC .cfg file (the spec is inferred from its name)")
+    ap.add_argument("--spec", help="spec/module name override")
+    ap.add_argument(
+        "--checker",
+        default=os.environ.get("CHECKER", "tpu"),
+        choices=["tpu", "oracle"],
+        help="backend: tpu (JAX device BFS) or oracle (pure-Python reference)",
+    )
+    ap.add_argument("--max-depth", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
+    ap.add_argument("--msg-slots", type=int, default=48)
+    ap.add_argument("--no-symmetry", action="store_true", help="ignore SYMMETRY")
+    ap.add_argument(
+        "--platform",
+        default=os.environ.get("RAFT_TPU_PLATFORM", "auto"),
+        choices=["auto", "cpu", "tpu", "axon"],
+        help="JAX platform (the image's axon TPU plugin ignores JAX_PLATFORMS, "
+        "so this forces it via jax.config)",
+    )
+    ap.add_argument("--verbose", "-v", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update(
+            "jax_platforms", {"tpu": "axon"}.get(args.platform, args.platform)
+        )
+
+    from .utils.cfg import CfgError, parse_cfg
+    from .models.registry import build_from_cfg
+
+    try:
+        cfg = parse_cfg(args.cfg)
+        setup = build_from_cfg(cfg, spec=args.spec, msg_slots=args.msg_slots)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 66
+    except CfgError as e:
+        # includes the deliberately-broken reference cfgs (SURVEY.md §2.2)
+        print(f"config error: {e}", file=sys.stderr)
+        return 64
+    symmetry = setup.symmetry and not args.no_symmetry
+    print(
+        f"spec={setup.model.name} servers={setup.server_names} "
+        f"values={setup.value_names} invariants={list(setup.invariants)} "
+        f"symmetry={symmetry} checker={args.checker}"
+    )
+
+    if args.checker == "oracle":
+        from .oracle.raft_oracle import oracle_for
+
+        oracle = oracle_for(setup.model.p)  # carries all variant knobs
+        res = oracle.bfs(
+            invariants=setup.invariants, symmetry=symmetry, max_depth=args.max_depth
+        )
+        print(
+            f"distinct={res['distinct']} total={res['total']} "
+            f"depth={len(res['depth_counts']) - 1}"
+        )
+        if res["violation"]:
+            print(f"INVARIANT {res['violation']['invariant']} VIOLATED")
+            return 2
+        print("no invariant violations")
+        return 0
+
+    from .checker.bfs import BFSChecker
+
+    checker = BFSChecker(
+        setup.model,
+        invariants=setup.invariants,
+        symmetry=symmetry,
+        chunk=args.chunk,
+    )
+    res = checker.run(max_depth=args.max_depth, verbose=args.verbose)
+    print(
+        f"distinct={res.distinct} total={res.total} depth={res.depth} "
+        f"terminal={res.terminal} time={res.seconds:.2f}s "
+        f"({res.states_per_sec:.0f} distinct/s)"
+    )
+    if res.violation:
+        print(f"INVARIANT {res.violation.invariant} VIOLATED (depth {res.violation.depth})")
+        if res.trace:
+            from .utils.pprint import format_trace
+
+            print(format_trace(res.trace, setup))
+        return 2
+    print("no invariant violations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,264 @@
+"""BFS model-checking driver (single-device v1).
+
+Replaces TLC's exhaustive BFS loop (SURVEY.md §3.1): frontier expansion and
+invariant evaluation are batched on device; dedup runs on 64-bit canonical
+fingerprints (VIEW + SYMMETRY, ops/symmetry.py) with the seen-set as a
+sorted uint64 array merged per wave (vectorized searchsorted — the Pallas
+cuckoo set slots in behind the same interface later). `-deadlock` TLC
+semantics: terminal states are legitimate, not errors (reference
+README.md:7), though we count them.
+
+Trace reconstruction: a parent-pointer journal (global state id, candidate
+id) per distinct state; counterexamples replay the action chain from the
+initial state (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from ..ops.hashing import U64_MAX
+from ..ops.symmetry import Canonicalizer
+
+
+def _in_sorted(sorted_arr: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Membership mask of vals in a sorted array (vectorized probe)."""
+    if not len(sorted_arr):
+        return np.zeros(len(vals), dtype=bool)
+    pos = np.clip(np.searchsorted(sorted_arr, vals), 0, len(sorted_arr) - 1)
+    return sorted_arr[pos] == vals
+
+
+def _merge_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two sorted disjoint uint64 arrays, O(len(a)+len(b))-ish."""
+    if not len(b):
+        return a
+    out = np.concatenate([a, b])
+    # both halves sorted and disjoint: a stable mergesort exploits the runs
+    out.sort(kind="stable")
+    return out
+
+
+@dataclass
+class Violation:
+    invariant: str
+    global_id: int
+    depth: int
+
+
+@dataclass
+class CheckResult:
+    distinct: int
+    total: int
+    depth: int  # BFS diameter reached
+    depth_counts: list[int]
+    violation: Violation | None
+    terminal: int  # states with no successors (reported under -deadlock)
+    seconds: float
+    states_per_sec: float
+    exhausted: bool = True  # False if stopped by max_depth/time budget
+    trace: list[tuple[str, dict]] | None = None  # (action label, decoded state)
+
+
+class BFSChecker:
+    def __init__(
+        self,
+        model,
+        invariants: tuple[str, ...] = (),
+        symmetry: bool = True,
+        chunk: int = 1024,
+        check_deadlock: bool = False,
+    ):
+        self.model = model
+        self.invariants = tuple(invariants)
+        self.chunk = chunk
+        self.check_deadlock = check_deadlock
+        self.canon = Canonicalizer(model.layout, model.packer, symmetry=symmetry)
+        self._expand = model.expand
+        self._fps = self.canon.fingerprints
+        # journal: per distinct state (beyond init): parent global id + candidate
+        self._parents: list[np.ndarray] = []
+        self._cands: list[np.ndarray] = []
+
+    # ---------------- main loop ----------------
+
+    def run(
+        self,
+        max_depth: int | None = None,
+        verbose: bool = False,
+        time_budget_s: float | None = None,
+    ) -> CheckResult:
+        model = self.model
+        B = self.chunk
+        t0 = time.perf_counter()
+        exhausted = True
+
+        init = model.init_states()
+        n0 = len(init)
+        init_fps = np.asarray(jax.device_get(self._fps(init)), dtype=np.uint64)
+        order = np.argsort(init_fps, kind="stable")
+        keep = np.ones(len(order), dtype=bool)  # dedup inits (all distinct normally)
+        sorted_fps = init_fps[order]
+        dup = np.zeros(len(order), dtype=bool)
+        dup[1:] = sorted_fps[1:] == sorted_fps[:-1]
+        keep[order[dup]] = False
+        frontier = init[keep]
+        self._init_distinct = frontier  # gid 0..k-1 (post-dedup numbering)
+        seen = np.sort(init_fps[keep])
+
+        total = n0
+        distinct = len(frontier)
+        depth_counts = [distinct]
+        terminal = 0
+        violation = None
+
+        viol = self._check_invariants(frontier, 0, 0)
+        if viol is not None:
+            violation = viol
+
+        depth = 0
+        base_gid = 0  # global id of first state in current frontier
+        next_gid = distinct
+        while len(frontier) and violation is None:
+            if max_depth is not None and depth >= max_depth:
+                exhausted = False
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
+                exhausted = False
+                break
+            new_states: list[np.ndarray] = []
+            new_parents: list[np.ndarray] = []
+            new_cands: list[np.ndarray] = []
+            # fingerprints first discovered this wave; kept separate from the
+            # (much larger) global seen-set so per-chunk dedup only re-sorts
+            # wave-sized arrays
+            wave_fps = np.empty(0, dtype=np.uint64)
+            n_cand_total = 0
+            has_succ = np.zeros(len(frontier), dtype=bool)
+            for off in range(0, len(frontier), B):
+                chunk_states = frontier[off : off + B]
+                nb = len(chunk_states)
+                if nb < B:  # pad to the compiled batch shape
+                    pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
+                    chunk_states = np.concatenate([chunk_states, pad], axis=0)
+                succs, valid, _rank, ovf = self._expand(chunk_states)
+                valid = np.array(jax.device_get(valid))
+                valid[nb:] = False
+                if np.any(valid & np.asarray(jax.device_get(ovf))):
+                    raise OverflowError(
+                        "message-slot overflow: re-run with a larger msg_slots"
+                    )
+                flat = succs.reshape(-1, model.layout.W)
+                fps = np.array(jax.device_get(self._fps(flat)), dtype=np.uint64)
+                fps[~valid.reshape(-1)] = U64_MAX
+                n_cand_total += int(valid.sum())
+                has_succ[off : off + nb] = valid[:nb].any(axis=1)
+
+                # first-occurrence-in-order selection of unseen fingerprints
+                new_mask = fps != U64_MAX
+                new_mask &= ~_in_sorted(seen, fps)
+                new_mask &= ~_in_sorted(wave_fps, fps)
+                # in-chunk dedup, keeping first occurrence
+                _, first_idx = np.unique(fps, return_index=True)
+                first = np.zeros(len(fps), dtype=bool)
+                first[first_idx] = True
+                new_mask &= first
+                idx = np.nonzero(new_mask)[0]
+                if len(idx):
+                    sel = np.asarray(jax.device_get(flat[idx]))
+                    new_states.append(sel)
+                    new_parents.append(base_gid + off + idx // model.A)
+                    new_cands.append((idx % model.A).astype(np.int32))
+                    wave_fps = np.sort(np.concatenate([wave_fps, fps[idx]]))
+
+            total += n_cand_total
+            terminal += int((~has_succ).sum())
+            if not new_states:
+                break
+            wave_states = np.concatenate(new_states, axis=0)
+            wave_parents = np.concatenate(new_parents)
+            wave_cands = np.concatenate(new_cands)
+            self._parents.append(wave_parents)
+            self._cands.append(wave_cands)
+            seen = _merge_sorted(seen, wave_fps)
+            depth += 1
+            depth_counts.append(len(wave_states))
+            violation = self._check_invariants(wave_states, next_gid, depth)
+            base_gid = next_gid
+            next_gid += len(wave_states)
+            distinct += len(wave_states)
+            frontier = wave_states
+            if verbose:
+                el = time.perf_counter() - t0
+                print(
+                    f"depth {depth}: frontier {len(wave_states)}, distinct {distinct}, "
+                    f"total {total}, {distinct/el:.0f} distinct/s"
+                )
+
+        dt = time.perf_counter() - t0
+        trace = self.reconstruct_trace(violation) if violation else None
+        return CheckResult(
+            distinct=distinct,
+            total=total,
+            depth=depth,
+            depth_counts=depth_counts,
+            violation=violation,
+            terminal=terminal,
+            seconds=dt,
+            states_per_sec=distinct / dt if dt > 0 else 0.0,
+            exhausted=exhausted and violation is None,
+            trace=trace,
+        )
+
+    def _check_invariants(self, states: np.ndarray, base_gid: int, depth: int):
+        """Batched invariant evaluation; returns the first (in exploration
+        order) violation, matching TLC's report-first-found behavior."""
+        for name in self.invariants:
+            ok = np.asarray(jax.device_get(self.model.invariants[name](states)))
+            bad = np.nonzero(~ok)[0]
+            if len(bad):
+                return Violation(invariant=name, global_id=base_gid + int(bad[0]), depth=depth)
+        return None
+
+    # ---------------- trace reconstruction ----------------
+
+    def _journal_lookup(self, gid: int) -> tuple[int, int]:
+        """(parent gid, candidate id) of a non-initial distinct state."""
+        off = gid - len(self._init_distinct)
+        for parents, cands in zip(self._parents, self._cands):
+            if off < len(parents):
+                return int(parents[off]), int(cands[off])
+            off -= len(parents)
+        raise KeyError(gid)
+
+    def reconstruct_trace(self, violation: Violation) -> list[tuple[str, dict]]:
+        """Replay the action chain from Init to the violating state.
+
+        Mirrors TLC's predecessor-chain trace reconstruction (SURVEY.md
+        §1.2): walk parent pointers to the root, then re-apply the recorded
+        candidate actions via the expansion kernel."""
+        model = self.model
+        n0 = len(self._init_distinct)
+        chain: list[tuple[int, int]] = []  # (parent, cand) from violation upward
+        gid = violation.global_id
+        while gid >= n0:
+            parent, cand = self._journal_lookup(gid)
+            chain.append((parent, cand))
+            gid = parent
+        chain.reverse()
+        state = self._init_distinct[gid]
+        out = [("Initial predicate", model.decode(state))]
+        for _parent, cand in chain:
+            succs, valid, rank, _ovf = jax.device_get(
+                self._expand(np.repeat(state[None, :], self.chunk, axis=0))
+            )
+            assert valid[0, cand], "journalled candidate not enabled on replay"
+            state = np.asarray(succs[0, cand])
+            out.append(
+                (self.model.action_label(int(rank[0, cand]), cand), model.decode(state))
+            )
+        return out

@@ -1,0 +1,98 @@
+"""State-vector layout machinery shared by all spec lowerings.
+
+Every spec variant lowers its TLA+ variables to a single flat ``int32[W]``
+vector per state. The layout records, per field, the *kind* of the field —
+how it transforms under a permutation of the server set — which lets the
+generic symmetry canonicalizer (ops/symmetry.py) serve every variant.
+
+Field ordering convention: all VIEW fields first, aux (VIEW-excluded)
+fields last, so the VIEW projection (``Raft.tla:115`` excludes
+``acked/electionCtr/restartCtr``) is the contiguous prefix
+``vec[:layout.view_len]``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+# Field kinds and their transformation under a server permutation sigma
+# (sigma maps old server index -> new server index):
+#   scalar           unaffected
+#   per_server       shape (S, ...): row r moves to row sigma(r)
+#   per_server_val   shape (S,), values in 0..S with 0 = Nil: rows move AND
+#                    values remap v -> sigma(v-1)+1
+#   server_bitmask   shape (S,), each element a bitmask over servers: rows
+#                    move AND bit j moves to bit sigma(j)
+#   per_server_pair  shape (S, S): new[sigma(a), sigma(b)] = old[a, b]
+#   msg_hi/msg_lo/   shape (M,): the message bag; server-valued fields inside
+#   msg_cnt          the packed key remap, then slots re-sort
+#   aux              VIEW-excluded scalar/vector (must come last)
+KINDS = (
+    "scalar",
+    "per_server",
+    "per_server_val",
+    "server_bitmask",
+    "per_server_pair",
+    "msg_hi",
+    "msg_lo",
+    "msg_cnt",
+    "aux",
+)
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    kind: str
+    shape: tuple[int, ...]
+    offset: int
+
+    @property
+    def size(self) -> int:
+        return int(math.prod(self.shape)) if self.shape else 1
+
+
+class Layout:
+    def __init__(self, n_servers: int):
+        self.n_servers = n_servers
+        self.fields: dict[str, Field] = {}
+        self.W = 0
+        self.view_len: int | None = None  # set when the first aux field lands
+
+    def add(self, name: str, kind: str, shape: tuple[int, ...] = ()) -> Field:
+        if kind not in KINDS:
+            raise ValueError(f"unknown kind {kind}")
+        if name in self.fields:
+            raise ValueError(f"duplicate field {name}")
+        if kind == "aux":
+            if self.view_len is None:
+                self.view_len = self.W
+        elif self.view_len is not None:
+            raise ValueError("non-aux field added after aux fields")
+        f = Field(name, kind, shape, self.W)
+        self.fields[name] = f
+        self.W += f.size
+        return f
+
+    def finish(self):
+        if self.view_len is None:
+            self.view_len = self.W
+        return self
+
+    def sl(self, name: str) -> slice:
+        f = self.fields[name]
+        return slice(f.offset, f.offset + f.size)
+
+    def get(self, vec, name: str):
+        """Slice field `name` out of a [..., W] vector, reshaped to its shape."""
+        f = self.fields[name]
+        out = vec[..., f.offset : f.offset + f.size]
+        if f.shape:
+            return out.reshape(vec.shape[:-1] + f.shape)
+        return out[..., 0]
+
+    def zeros(self, batch: tuple[int, ...] = ()) -> np.ndarray:
+        return np.zeros(batch + (self.W,), dtype=np.int32)

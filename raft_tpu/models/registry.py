@@ -1,0 +1,106 @@
+"""Spec registry: maps a TLA+ module name to its TPU lowering builder.
+
+Each builder consumes a parsed TLC cfg (utils/cfg.py) and returns a ready
+model plus checking options — the ``CHECKER=tpu`` toggle's dispatch table.
+Variants land here as they are lowered (SURVEY.md §7.1 order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.cfg import Cfg, CfgError
+from .raft import RaftModel, RaftParams
+
+
+@dataclass
+class CheckSetup:
+    model: object
+    invariants: tuple[str, ...]
+    symmetry: bool
+    server_names: list[str]
+    value_names: list[str]
+
+
+def _require_int(cfg: Cfg, name: str) -> int:
+    if name not in cfg.constants:
+        raise CfgError(f"{cfg.path}: required constant {name} is missing")
+    v = cfg.constants[name]
+    if not isinstance(v, int) or isinstance(v, bool):
+        raise CfgError(f"{cfg.path}: constant {name} must be a number, got {v!r}")
+    return v
+
+
+def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+    """standard-raft/Raft.tla + Raft.cfg."""
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = RaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        msg_slots=msg_slots,
+    )
+    model = RaftModel(params, server_names=servers, value_names=values)
+    unknown = [i for i in cfg.invariants if i not in model.invariants]
+    if unknown:
+        raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
+def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+    """flexible-raft/FlexibleRaft.tla + FlexibleRaft.cfg: structurally core
+    Raft with count-based quorums (FlexibleRaft.tla:262,296), strictly
+    send-once messaging (:127-151), no pendingResponse (:109), and
+    term-mismatch-only truncation (:413-416)."""
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = RaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        msg_slots=msg_slots,
+        election_quorum=_require_int(cfg, "ElectionQuorumSize"),
+        replication_quorum=_require_int(cfg, "ReplicationQuorumSize"),
+        strict_send_once=True,
+        has_pending_response=False,
+        trunc_term_mismatch=True,
+    )
+    model = RaftModel(params, server_names=servers, value_names=values)
+    model.name = "FlexibleRaft"
+    unknown = [i for i in cfg.invariants if i not in model.invariants]
+    if unknown:
+        raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
+BUILDERS = {
+    "Raft": build_raft,
+    "FlexibleRaft": build_flexible_raft,
+}
+
+
+def build_from_cfg(cfg: Cfg, spec: str | None = None, msg_slots: int = 48) -> CheckSetup:
+    import os
+
+    name = spec or os.path.splitext(os.path.basename(cfg.path))[0]
+    if name not in BUILDERS:
+        raise CfgError(
+            f"no TPU lowering registered for spec {name!r} "
+            f"(available: {', '.join(sorted(BUILDERS))})"
+        )
+    return BUILDERS[name](cfg, msg_slots=msg_slots)

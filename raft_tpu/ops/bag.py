@@ -1,0 +1,69 @@
+"""Branchless message-bag kernels.
+
+TLA+ semantics being reproduced (reference ``standard-raft/Raft.tla``):
+  - the bag is a function record -> delivery count (``Raft.tla:55-58``);
+  - ``Discard`` decrements the count but the record STAYS in the domain
+    (``Raft.tla:164-167``) — this is what makes ``_SendOnce`` a permanent
+    action-disable latch (``Raft.tla:134-138``). Hence slots are never
+    freed: the slot table grows monotonically within a behavior and
+    count-0 slots are genuine state that must fingerprint.
+
+Encoding: three int32 lanes per bag — sorted key words ``hi``/``lo``
+(30 bits each, see ops/packing.py) plus ``cnt``. Unused slots hold
+(EMPTY, EMPTY, 0) and sort last; keys are unique, so the sorted triple is
+a canonical form and bag equality is array equality.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .packing import EMPTY
+
+
+def bag_sort(hi, lo, cnt):
+    """Canonicalize: sort slots lexicographically by (hi, lo); empties last."""
+    hi, lo, cnt = lax.sort((hi, lo, cnt), num_keys=2)
+    return hi, lo, cnt
+
+
+def bag_count(hi, lo, cnt, khi, klo):
+    """Delivery count of a key (0 if not in the domain)."""
+    eq = (hi == khi) & (lo == klo)
+    return jnp.sum(jnp.where(eq, cnt, 0))
+
+
+def bag_put(hi, lo, cnt, khi, klo):
+    """Add one delivery of key (khi, klo) — TLA+ ``_SendNoRestriction``
+    (``Raft.tla:129-132``): increment if the record is in the domain, else
+    insert with count 1.
+
+    Returns (hi, lo, cnt, existed, overflow). ``existed`` lets callers
+    implement ``_SendOnce`` (valid iff not existed). ``overflow`` is True
+    when an insert was needed but no slot was free — the driver must abort
+    and re-run with more slots (never silently dropped).
+    """
+    eq = (hi == khi) & (lo == klo)
+    existed = eq.any()
+    cnt_inc = cnt + eq.astype(cnt.dtype)
+
+    is_empty = hi == EMPTY
+    slot = jnp.argmax(is_empty)  # empties are sorted last; any empty works
+    have_empty = is_empty.any()
+    hi_ins = hi.at[slot].set(khi)
+    lo_ins = lo.at[slot].set(klo)
+    cnt_ins = cnt.at[slot].set(jnp.int32(1))
+
+    hi2 = jnp.where(existed, hi, hi_ins)
+    lo2 = jnp.where(existed, lo, lo_ins)
+    cnt2 = jnp.where(existed, cnt_inc, cnt_ins)
+    overflow = (~existed) & (~have_empty)
+    hi2, lo2, cnt2 = bag_sort(hi2, lo2, cnt2)
+    return hi2, lo2, cnt2, existed, overflow
+
+
+def bag_discard_at(cnt, slot):
+    """``Discard`` (``Raft.tla:164-167``): one fewer delivery; domain keeps
+    the record, so keys don't move and no re-sort is needed."""
+    return cnt.at[slot].add(jnp.int32(-1))

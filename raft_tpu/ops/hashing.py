@@ -1,0 +1,39 @@
+"""64-bit state fingerprinting.
+
+TLC dedups on 64-bit fingerprints of the (VIEW-projected, symmetry-reduced)
+state; we reproduce the same collision budget with a vectorized
+Zobrist-style hash: each lane of the int32 state vector is avalanche-mixed
+together with its position, lanes XOR-reduce, and a final mix finishes.
+XOR-reduction keeps the hash embarrassingly parallel (MXU/VPU friendly)
+while position mixing preserves order sensitivity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)  # golden-ratio increment (splitmix64)
+_C2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+
+U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)  # "no fingerprint" sentinel
+
+
+def mix64(z):
+    """splitmix64 finalizer — full-avalanche 64-bit mix."""
+    z = (z ^ (z >> np.uint64(30))) * _M1
+    z = (z ^ (z >> np.uint64(27))) * _M2
+    return z ^ (z >> np.uint64(31))
+
+
+def hash_lanes(vec, seed: int = 0):
+    """Hash an int32 [..., K] vector to uint64 [...]."""
+    k = vec.shape[-1]
+    x = vec.astype(jnp.uint64)
+    pos = jnp.arange(k, dtype=jnp.uint64)
+    h = mix64((x + np.uint64(seed)) * _C1 + pos * _C2)
+    acc = jnp.bitwise_xor.reduce(h, axis=-1)
+    kmix = np.uint64((k * int(_C1)) & 0xFFFFFFFFFFFFFFFF)
+    return mix64(acc ^ kmix)

@@ -1,0 +1,94 @@
+"""Bit-packing of TLA+ message records into two non-negative int32 words.
+
+The reference specs model the network as a bag: a function from message
+records to delivery counts (``Raft.tla:55-58``). Record equality is
+full-field equality, so a record packs losslessly into a fixed-width bit
+string; bag membership / lookup then becomes integer comparison, and bag
+canonicalization becomes an integer sort.
+
+We pack into two 30-bit words (``hi``, ``lo``) kept in int32 lanes of the
+state vector. 30 bits per word keeps every word non-negative, so
+lexicographic (hi, lo) sorting with signed comparisons gives the correct
+unsigned order, and the EMPTY sentinel (1 << 30) sorts after all real keys.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WORD_BITS = 30
+EMPTY = np.int32(1 << WORD_BITS)  # sentinel word for unused message slots
+
+
+class BitPacker:
+    """Packs a fixed schema of small unsigned fields into (hi, lo) words.
+
+    Fields are laid out low-bit-first in declaration order; a field that
+    would straddle the 30-bit word boundary is bumped to the next word.
+    Works on numpy arrays, jax arrays and plain ints (pure arithmetic).
+    """
+
+    def __init__(self, fields: list[tuple[str, int]]):
+        self.fields: dict[str, tuple[int, int]] = {}  # name -> (offset, bits)
+        off = 0
+        for name, bits in fields:
+            if bits <= 0:
+                raise ValueError(f"field {name} has non-positive width")
+            word, in_word = divmod(off, WORD_BITS)
+            if in_word + bits > WORD_BITS:  # would straddle: bump to next word
+                off = (word + 1) * WORD_BITS
+            if off + bits > 2 * WORD_BITS:
+                raise ValueError("message schema exceeds 60 bits")
+            self.fields[name] = (off, bits)
+            off += bits
+        self.total_bits = off
+
+    def field_names(self) -> list[str]:
+        return list(self.fields)
+
+    def pack(self, **vals):
+        """Pack named field values into (hi, lo). Missing fields are 0."""
+        unknown = set(vals) - set(self.fields)
+        if unknown:
+            raise KeyError(f"unknown message fields {unknown}")
+        hi = 0
+        lo = 0
+        for name, v in vals.items():
+            off, bits = self.fields[name]
+            if isinstance(v, (int, np.integer)):
+                if v < 0 or v >= (1 << bits):
+                    raise ValueError(f"{name}={v} out of range for {bits} bits")
+                v = int(v)
+            word, in_word = divmod(off, WORD_BITS)
+            placed = v << in_word
+            if word == 0:
+                lo = lo + placed
+            else:
+                hi = hi + placed
+        return hi, lo
+
+    def unpack(self, hi, lo, name: str):
+        """Extract one field from (hi, lo); works on arrays or ints."""
+        off, bits = self.fields[name]
+        word, in_word = divmod(off, WORD_BITS)
+        src = hi if word == 1 else lo
+        return (src >> in_word) & ((1 << bits) - 1)
+
+    def unpack_all(self, hi, lo) -> dict:
+        return {name: self.unpack(hi, lo, name) for name in self.fields}
+
+    def replace(self, hi, lo, name: str, value):
+        """Return (hi, lo) with one field replaced; array-friendly."""
+        off, bits = self.fields[name]
+        word, in_word = divmod(off, WORD_BITS)
+        mask = ((1 << bits) - 1) << in_word
+        if word == 1:
+            hi = (hi & ~mask) | (value << in_word)
+        else:
+            lo = (lo & ~mask) | (value << in_word)
+        return hi, lo
+
+
+def bits_for(max_value: int) -> int:
+    """Width needed to store values in [0, max_value]."""
+    return max(1, int(max_value).bit_length())

@@ -1,0 +1,134 @@
+"""VIEW projection + SYMMETRY reduction + fingerprinting, layout-driven.
+
+Reproduces TLC's distinct-state semantics for cfgs that declare
+``VIEW view`` / ``SYMMETRY symmServers`` (e.g. ``standard-raft/Raft.cfg:28-29``):
+
+  - VIEW: aux counters are excluded from the fingerprint
+    (``Raft.tla:115`` — ``view`` omits ``acked/electionCtr/restartCtr``).
+    By layout convention the view is the contiguous prefix
+    ``vec[:layout.view_len]``.
+  - SYMMETRY: two states related by a server permutation are the same
+    distinct state (``Raft.tla:116``). We canonicalize by taking the MIN
+    over all S! permutations of the permuted view's 64-bit hash — a
+    permutation-invariant fingerprint with TLC's collision budget.
+
+A permutation sigma acts on the packed view as (see models/base.py kinds):
+row gathers for server-indexed axes, value remaps for server-valued fields
+and bitmasks, msource/mdest remap inside packed message keys followed by a
+bag re-sort. The row gathers compose into ONE precomputed lane-gather per
+permutation, so the device work per permutation is a gather + two tiny
+fixups + an M-lane sort + hash.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .hashing import hash_lanes
+from .packing import EMPTY, BitPacker
+from ..models.base import Layout
+
+
+class Canonicalizer:
+    def __init__(
+        self,
+        layout: Layout,
+        packer: BitPacker,
+        msg_server_fields: tuple[str, ...] = ("msource", "mdest"),
+        symmetry: bool = True,
+    ):
+        S = layout.n_servers
+        VL = layout.view_len
+        assert VL is not None
+        self.layout = layout
+        self.packer = packer
+        self.msg_server_fields = msg_server_fields
+
+        if symmetry:
+            perms = np.array(list(itertools.permutations(range(S))), dtype=np.int32)
+        else:
+            perms = np.arange(S, dtype=np.int32)[None, :]
+        P = perms.shape[0]
+        inv = np.argsort(perms, axis=1).astype(np.int32)
+
+        # Per-permutation lane gather over the view prefix.
+        gidx = np.tile(np.arange(VL, dtype=np.int32), (P, 1))
+        val_lanes: list[int] = []
+        bm_lanes: list[int] = []
+        msg_sl: dict[str, slice] = {}
+        for f in layout.fields.values():
+            if f.offset >= VL:
+                continue  # aux: not fingerprinted
+            if f.kind in ("per_server", "per_server_val", "server_bitmask"):
+                rest = int(math.prod(f.shape[1:])) if len(f.shape) > 1 else 1
+                base = f.offset + inv[:, :, None] * rest + np.arange(rest)  # [P,S,rest]
+                gidx[:, f.offset : f.offset + f.size] = base.reshape(P, -1)
+                lanes = list(range(f.offset, f.offset + f.size))
+                if f.kind == "per_server_val":
+                    val_lanes += lanes
+                elif f.kind == "server_bitmask":
+                    bm_lanes += lanes
+            elif f.kind == "per_server_pair":
+                src = f.offset + inv[:, :, None] * S + inv[:, None, :]  # [P,S,S]
+                gidx[:, f.offset : f.offset + f.size] = src.reshape(P, -1)
+            elif f.kind in ("msg_hi", "msg_lo", "msg_cnt"):
+                msg_sl[f.kind] = layout.sl(f.name)
+
+        # value remap: 0 stays Nil, v in 1..S maps to sigma[v-1]+1
+        valmap = np.zeros((P, S + 1), dtype=np.int32)
+        valmap[:, 1:] = perms + 1
+        pow2sig = (1 << perms).astype(np.int32)
+
+        self.S, self.P, self.VL = S, P, VL
+        self._gidx = jnp.asarray(gidx)
+        self._sigma = jnp.asarray(perms)
+        self._valmap = jnp.asarray(valmap)
+        self._pow2sig = jnp.asarray(pow2sig)
+        self._val_lanes = np.array(sorted(val_lanes), dtype=np.int32)
+        self._bm_lanes = np.array(sorted(bm_lanes), dtype=np.int32)
+        self._msg_sl = msg_sl
+        self.fingerprints = jax.jit(self._fingerprints)
+
+    def _one_perm(self, view, gi, valmap, pow2, sigma):
+        """Apply one permutation to [B, VL] views and hash."""
+        S = self.S
+        v = view[:, gi]
+        if self._val_lanes.size:
+            vl = v[:, self._val_lanes]
+            v = v.at[:, self._val_lanes].set(valmap[vl])
+        if self._bm_lanes.size:
+            x = v[:, self._bm_lanes]
+            bits = (x[..., None] >> jnp.arange(S, dtype=jnp.int32)) & 1
+            v = v.at[:, self._bm_lanes].set(jnp.sum(bits * pow2, axis=-1).astype(jnp.int32))
+        if self._msg_sl:
+            hi = v[:, self._msg_sl["msg_hi"]]
+            lo = v[:, self._msg_sl["msg_lo"]]
+            cnt = v[:, self._msg_sl["msg_cnt"]]
+            occ = hi != EMPTY
+            nhi, nlo = hi, lo
+            for fname in self.msg_server_fields:
+                val = self.packer.unpack(nhi, nlo, fname)
+                nhi, nlo = self.packer.replace(nhi, nlo, fname, sigma[jnp.clip(val, 0, S - 1)])
+            nhi = jnp.where(occ, nhi, hi)
+            nlo = jnp.where(occ, nlo, lo)
+            nhi, nlo, cnt = lax.sort((nhi, nlo, cnt), num_keys=2)
+            v = (
+                v.at[:, self._msg_sl["msg_hi"]].set(nhi)
+                .at[:, self._msg_sl["msg_lo"]].set(nlo)
+                .at[:, self._msg_sl["msg_cnt"]].set(cnt)
+            )
+        return hash_lanes(v)
+
+    def _fingerprints(self, states):
+        """[B, W] int32 -> uint64 [B] canonical fingerprints."""
+        view = states[:, : self.VL]
+        fps = jax.vmap(
+            lambda gi, vm, p2, sg: self._one_perm(view, gi, vm, p2, sg)
+        )(self._gidx, self._valmap, self._pow2sig, self._sigma)
+        return jnp.min(fps, axis=0)

@@ -1,0 +1,258 @@
+"""Sharded-frontier BFS over a ``jax.sharding.Mesh``.
+
+The TPU-native replacement for TLC's shared-memory worker threads
+(``tlc -workers N``, SURVEY.md §5.8): each chip owns the slice of
+fingerprint space ``fp mod D`` (D = mesh size). A wave is one
+``shard_map``-ed program per chip:
+
+    expand local frontier (vmap) -> fingerprint -> route successors to
+    their owner chip via ``jax.lax.all_to_all`` over ICI -> local
+    sort-unique dedup + probe of the chip-resident seen-set -> append to
+    the local frontier; global termination via ``psum`` of new-state
+    counts.
+
+All buffers are fixed-capacity (XLA static shapes); every capacity has an
+overflow flag that aborts the run rather than dropping states. Multi-host
+scale-out is the same collective over DCN (mesh spanning hosts).
+
+State counts are exact and deterministic; within-wave discovery ORDER
+differs from the sequential driver (first-occurrence tie-breaking is by
+owner chip), which can pick a different—equally shortest—counterexample.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.hashing import U64_MAX
+from ..ops.symmetry import Canonicalizer
+
+AXIS = "shards"
+
+
+@dataclass
+class ShardedResult:
+    distinct: int
+    total: int
+    depth: int
+    depth_counts: list[int]
+    violation_invariant: str | None
+    seconds: float
+    states_per_sec: float
+
+
+class ShardedBFS:
+    def __init__(
+        self,
+        model,
+        invariants: tuple[str, ...] = (),
+        symmetry: bool = True,
+        devices=None,
+        chunk: int = 256,  # per-device states expanded per wave step
+        route_cap: int | None = None,  # per (src,dst) routed successors
+        frontier_cap: int = 1 << 15,  # per-device frontier buffer
+        seen_cap: int = 1 << 20,  # per-device seen-set capacity
+    ):
+        self.model = model
+        self.invariants = tuple(invariants)
+        devices = devices if devices is not None else jax.devices()
+        self.D = len(devices)
+        self.mesh = Mesh(np.array(devices), (AXIS,))
+        self.chunk = chunk
+        self.A = model.A
+        self.route_cap = route_cap or max(256, (chunk * self.A) // self.D)
+        self.frontier_cap = frontier_cap
+        self.seen_cap = seen_cap
+        self.canon = Canonicalizer(model.layout, model.packer, symmetry=symmetry)
+        self.W = model.layout.W
+
+        spec = P(AXIS)
+        self._wave = jax.jit(
+            jax.shard_map(
+                self._wave_local,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec, spec),
+                out_specs=(spec, spec, spec, spec, P(), P()),
+            )
+        )
+
+    # ---------- device-local wave (runs per chip under shard_map) ----------
+
+    def _wave_local(self, frontier, fcount, seen, scount):
+        """frontier [F, W], fcount [1], seen [SC] sorted u64, scount [1].
+
+        Returns (new_frontier [F, W], new_fcount [1], new_seen [SC],
+        new_scount [1], global_new, flags) where flags packs overflow bits
+        and the index of the first violated invariant (or -1)."""
+        model, D, A, W = self.model, self.D, self.A, self.W
+        F, RC, SC = self.frontier_cap, self.route_cap, self.seen_cap
+        C = self.chunk
+        # shard_map hands us the local block with its leading mesh axis of 1
+        frontier, fcount, seen, scount = frontier[0], fcount[0], seen[0], scount[0]
+
+        # 1. expand the first `chunk` live states (driver guarantees
+        #    fcount <= chunk per wave by sub-stepping)
+        live = jnp.arange(C) < fcount[0]
+        batch = frontier[:C]
+        succs, valid, _rank, ovf = jax.vmap(model._expand1)(batch)
+        valid = valid & live[:, None]
+        expand_ovf = jnp.any(valid & ovf)
+        flat = succs.reshape(C * A, W)
+        fps = self.canon._fingerprints(flat)
+        fps = jnp.where(valid.reshape(-1), fps, U64_MAX)
+        n_generated = jnp.sum(valid)
+
+        # 2. route to owner chip = fp mod D, fixed RC slots per destination
+        owner = (fps % np.uint64(D)).astype(jnp.int32)
+        owner = jnp.where(fps == U64_MAX, D, owner)  # invalid -> drop lane
+        order = jnp.argsort(owner, stable=True)
+        owner_s = owner[order]
+        fps_s = fps[order]
+        start = jnp.searchsorted(owner_s, jnp.arange(D + 1), side="left")
+        pos_in_owner = jnp.arange(C * A) - start[owner_s]
+        ok = (owner_s < D) & (pos_in_owner < RC)
+        route_ovf = jnp.any((owner_s < D) & (pos_in_owner >= RC))
+        slot = jnp.where(ok, owner_s * RC + pos_in_owner, D * RC)
+        send_states = jnp.zeros((D * RC + 1, W), jnp.int32).at[slot].set(flat[order])[:-1]
+        send_fps = jnp.full((D * RC + 1,), U64_MAX, jnp.uint64).at[slot].set(fps_s)[:-1]
+
+        # 3. ICI all-to-all: block d goes to chip d
+        recv_states = lax.all_to_all(send_states, AXIS, 0, 0, tiled=True)
+        recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
+
+        # 4. local dedup: sort by fp, drop repeats + already-seen
+        sidx = jnp.argsort(recv_fps)
+        rf = recv_fps[sidx]
+        uniq = jnp.ones_like(rf, dtype=bool).at[1:].set(rf[1:] != rf[:-1])
+        probe = jnp.searchsorted(seen, rf)
+        in_seen = seen[jnp.clip(probe, 0, SC - 1)] == rf
+        newm = uniq & ~in_seen & (rf != U64_MAX)
+        n_new = jnp.sum(newm)
+
+        # 5. append to local frontier buffer (compact the survivors first)
+        BUF = max(F, D * RC) + 1  # scatter buffer; last row is the drop lane
+        dst = jnp.where(newm, jnp.cumsum(newm) - 1, BUF - 1)
+        frontier_ovf = n_new > F
+        compact = (
+            jnp.zeros((BUF, W), jnp.int32).at[dst].set(recv_states[sidx])[:F]
+        )
+        new_fps_compact = (
+            jnp.full((BUF,), U64_MAX, jnp.uint64)
+            .at[dst]
+            .set(jnp.where(newm, rf, U64_MAX))[:-1]
+        )
+
+        # 6. merge into the seen-set (sorted-array union)
+        seen_ovf = scount[0] + n_new > SC
+        merged = jnp.sort(jnp.concatenate([seen, new_fps_compact]))[:SC]
+
+        # 7. invariants on the newly discovered states
+        inv_viol = jnp.int32(-1)
+        if self.invariants:
+            livemask = jnp.arange(F) < n_new
+            for k, name in reversed(list(enumerate(self.invariants))):
+                ok_inv = self.model.invariants[name](compact)
+                bad = jnp.any(~ok_inv & livemask)
+                inv_viol = jnp.where(bad, jnp.int32(k), inv_viol)
+        inv_viol = lax.pmax(inv_viol, AXIS)
+
+        global_new = lax.psum(n_new, AXIS)
+        global_total = lax.psum(n_generated, AXIS)
+        ovf_bits = (
+            expand_ovf.astype(jnp.int32)
+            + 2 * route_ovf.astype(jnp.int32)
+            + 4 * frontier_ovf.astype(jnp.int32)
+            + 8 * seen_ovf.astype(jnp.int32)
+        )
+        flags = jnp.stack(
+            [lax.pmax(ovf_bits, AXIS), inv_viol, global_new.astype(jnp.int32)]
+        )
+        return (
+            compact[None],
+            n_new[None, None].astype(jnp.int32),
+            merged[None],
+            (scount[0] + n_new)[None, None].astype(jnp.int32),
+            global_total.astype(jnp.int64),
+            flags,
+        )
+
+    # ---------- host driver ----------
+
+    def run(self, max_depth: int | None = None, verbose: bool = False) -> ShardedResult:
+        import time
+
+        model, D, W = self.model, self.D, self.W
+        F, SC, C = self.frontier_cap, self.seen_cap, self.chunk
+        t0 = time.perf_counter()
+
+        init = model.init_states()
+        init_fps = np.array(jax.device_get(self.canon.fingerprints(init)), dtype=np.uint64)
+        frontier = np.zeros((D, F, W), np.int32)
+        fcount = np.zeros((D, 1), np.int32)
+        seen = np.full((D, SC), U64_MAX, np.uint64)
+        scount = np.zeros((D, 1), np.int32)
+        for k in range(len(init)):
+            d = int(init_fps[k] % D)
+            frontier[d, fcount[d, 0]] = init[k]
+            seen[d, fcount[d, 0]] = init_fps[k]
+            fcount[d, 0] += 1
+            scount[d, 0] += 1
+        seen = np.sort(seen, axis=1)
+
+        distinct = len(init)
+        total = len(init)
+        depth_counts = [distinct]
+        depth = 0
+        violation = None
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        frontier = jax.device_put(frontier, sharding)
+        fcount = jax.device_put(fcount, sharding)
+        seen = jax.device_put(seen, sharding)
+        scount = jax.device_put(scount, sharding)
+
+        while violation is None:
+            if max_depth is not None and depth >= max_depth:
+                break
+            # NOTE v1: one wave expands at most `chunk` states per device;
+            # larger frontiers would need sub-stepping (future work uses a
+            # cursor into the frontier buffer).
+            if int(np.max(np.array(jax.device_get(fcount)))) > C:
+                raise OverflowError(
+                    "per-device frontier exceeds chunk; raise chunk for this model"
+                )
+            frontier, fcount, seen, scount, wave_total, flags = self._wave(
+                frontier, fcount, seen, scount
+            )
+            flags_h = np.array(jax.device_get(flags))
+            ovf_bits, inv_idx, global_new = int(flags_h[0]), int(flags_h[1]), int(flags_h[2])
+            if ovf_bits:
+                raise OverflowError(f"sharded BFS capacity overflow (bits={ovf_bits:04b})")
+            total += int(np.array(jax.device_get(wave_total)))
+            if global_new == 0:
+                break
+            depth += 1
+            distinct += global_new
+            depth_counts.append(global_new)
+            if inv_idx >= 0:
+                violation = self.invariants[inv_idx]
+            if verbose:
+                print(f"depth {depth}: +{global_new} distinct={distinct}")
+
+        dt = time.perf_counter() - t0
+        return ShardedResult(
+            distinct=distinct,
+            total=total,
+            depth=depth,
+            depth_counts=depth_counts,
+            violation_invariant=violation,
+            seconds=dt,
+            states_per_sec=distinct / dt if dt > 0 else 0.0,
+        )

@@ -1,0 +1,135 @@
+"""TLC-style pretty-printing of decoded states and counterexample traces.
+
+Formats states in TLA+ value syntax (records, functions, sequences, bags)
+the way TLC prints them in error traces, using the cfg's model-value names
+— the human-facing half of "bit-for-bit counterexample parity".
+"""
+
+from __future__ import annotations
+
+STATE_NAMES = {0: "Follower", 1: "Candidate", 2: "Leader"}
+
+
+def _srv(setup, i) -> str:
+    return setup.server_names[i]
+
+
+def _val(setup, v) -> str:
+    return setup.value_names[v]
+
+
+def _fmt_fun(pairs) -> str:
+    return "(" + " @@ ".join(f"{k} :> {v}" for k, v in pairs) + ")"
+
+
+def _fmt_msg(setup, rec) -> str:
+    d = dict(rec)
+    parts = []
+    for k, v in rec:
+        if k in ("msource", "mdest"):
+            v = _srv(setup, v)
+        elif k == "mentries":
+            v = (
+                "<<"
+                + ", ".join(
+                    f"[term |-> {t}, value |-> {_val(setup, val)}]" for t, val in v
+                )
+                + ">>"
+            )
+        elif isinstance(v, bool):
+            v = "TRUE" if v else "FALSE"
+        parts.append(f"{k} |-> {v}")
+    return "[" + ", ".join(parts) + "]"
+
+
+def format_state(setup, st: dict) -> str:
+    S = len(st["currentTerm"])
+    sv = lambda i: _srv(setup, i)
+    lines = []
+    lines.append(
+        "/\\ currentTerm = "
+        + _fmt_fun((sv(i), st["currentTerm"][i]) for i in range(S))
+    )
+    lines.append(
+        "/\\ state = "
+        + _fmt_fun((sv(i), STATE_NAMES[st["state"][i]]) for i in range(S))
+    )
+    lines.append(
+        "/\\ votedFor = "
+        + _fmt_fun(
+            (sv(i), "Nil" if st["votedFor"][i] is None else sv(st["votedFor"][i]))
+            for i in range(S)
+        )
+    )
+    lines.append(
+        "/\\ votesGranted = "
+        + _fmt_fun(
+            (sv(i), "{" + ", ".join(sv(j) for j in sorted(st["votesGranted"][i])) + "}")
+            for i in range(S)
+        )
+    )
+    lines.append(
+        "/\\ log = "
+        + _fmt_fun(
+            (
+                sv(i),
+                "<<"
+                + ", ".join(
+                    f"[term |-> {t}, value |-> {_val(setup, v)}]" for t, v in st["log"][i]
+                )
+                + ">>",
+            )
+            for i in range(S)
+        )
+    )
+    lines.append(
+        "/\\ commitIndex = "
+        + _fmt_fun((sv(i), st["commitIndex"][i]) for i in range(S))
+    )
+    for name in ("nextIndex", "matchIndex", "pendingResponse"):
+        lines.append(
+            f"/\\ {name} = "
+            + _fmt_fun(
+                (
+                    sv(i),
+                    _fmt_fun(
+                        (
+                            sv(j),
+                            "TRUE"
+                            if st[name][i][j] is True
+                            else ("FALSE" if st[name][i][j] is False else st[name][i][j]),
+                        )
+                        for j in range(S)
+                    ),
+                )
+                for i in range(S)
+            )
+        )
+    msgs = sorted(st["messages"])
+    lines.append(
+        "/\\ messages = ("
+        + " @@ ".join(f"{_fmt_msg(setup, m)} :> {c}" for m, c in msgs)
+        + ")"
+    )
+    lines.append(
+        "/\\ acked = "
+        + _fmt_fun(
+            (
+                _val(setup, v),
+                {None: "Nil", False: "FALSE", True: "TRUE"}[st["acked"][v]],
+            )
+            for v in range(len(st["acked"]))
+        )
+    )
+    lines.append(f"/\\ electionCtr = {st['electionCtr']}")
+    lines.append(f"/\\ restartCtr = {st['restartCtr']}")
+    return "\n".join(lines)
+
+
+def format_trace(trace, setup) -> str:
+    out = []
+    for n, (label, st) in enumerate(trace, start=1):
+        out.append(f"State {n}: <{label}>")
+        out.append(format_state(setup, st))
+        out.append("")
+    return "\n".join(out)

@@ -1,0 +1,75 @@
+"""FlexibleRaft differential tests: variant kernels vs the variant oracle,
+plus full-BFS count parity and reference-cfg loading."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.models.raft import RaftModel, RaftParams, cached_model
+from raft_tpu.oracle.raft_oracle import oracle_for
+
+from conftest import collect_states as _collect_states
+
+FLEX = RaftParams(
+    n_servers=3,
+    n_values=1,
+    max_elections=2,
+    max_restarts=0,
+    msg_slots=24,
+    election_quorum=2,
+    replication_quorum=3,
+    strict_send_once=True,
+    has_pending_response=False,
+    trunc_term_mismatch=True,
+)
+
+
+def test_flexible_successor_sets_match_oracle():
+    model = cached_model(FLEX)
+    oracle = oracle_for(FLEX)
+    states = _collect_states(oracle, max_depth=6, cap=150)
+    vecs = np.stack([model.encode(st) for st in states])
+    succs, valid, rank, ovf = jax.device_get(model.expand(vecs))
+    assert not np.any(valid & ovf)
+    for b, st in enumerate(states):
+        got = sorted(
+            oracle.serialize_full(model.decode(succs[b, a]))
+            for a in range(model.A)
+            if valid[b, a]
+        )
+        want = sorted(oracle.serialize_full(s2) for _l, s2 in oracle.successors(st))
+        assert got == want, f"successor mismatch at state {b}"
+
+
+def test_flexible_bfs_counts_match_oracle():
+    model = cached_model(FLEX)
+    oracle = oracle_for(FLEX)
+    checker = BFSChecker(
+        model,
+        invariants=("LeaderHasAllAckedValues", "NoLogDivergence"),
+        symmetry=True,
+        chunk=256,
+    )
+    res = checker.run(max_depth=10)
+    ores = oracle.bfs(
+        invariants=("LeaderHasAllAckedValues", "NoLogDivergence"),
+        symmetry=True,
+        max_depth=10,
+    )
+    assert res.violation is None and ores["violation"] is None
+    assert res.distinct == ores["distinct"]
+    assert res.depth_counts == ores["depth_counts"]
+
+
+def test_reference_flexible_cfg_loads():
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    cfg = parse_cfg("/root/reference/specifications/flexible-raft/FlexibleRaft.cfg")
+    setup = build_from_cfg(cfg, msg_slots=16)
+    p = setup.model.p
+    assert p.n_servers == 5 and p.election_quorum == 3 and p.replication_quorum == 4
+    assert p.strict_send_once and not p.has_pending_response and p.trunc_term_mismatch
+    assert setup.model.name == "FlexibleRaft"
