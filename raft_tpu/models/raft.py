@@ -52,6 +52,7 @@ RVREQ, RVRESP, AEREQ, AERESP = 1, 2, 3, 4  # mtype (Raft.tla:44-45)
     R_ACCEPT_AE,
     R_HANDLE_AERESP,
 ) = range(12)
+R_TIMEOUT, R_ADVANCEFSYNC = 12, 13  # RaftFsync-only disjuncts
 
 ACTION_NAMES = [
     "Restart",
@@ -66,6 +67,8 @@ ACTION_NAMES = [
     "RejectAppendEntriesRequest",
     "AcceptAppendEntriesRequest",
     "HandleAppendEntriesResponse",
+    "Timeout",
+    "AdvanceFsyncIndex",
 ]
 
 STATE_NAMES = {FOLLOWER: "Follower", CANDIDATE: "Candidate", LEADER: "Leader"}
@@ -99,6 +102,16 @@ class RaftParams:
     # FlexibleRaft's NeedsTruncation is a term-mismatch test with no
     # empty-entries arm (FlexibleRaft.tla:413-416 vs Raft.tla:445-449).
     trunc_term_mismatch: bool = False
+    # RaftFsync (raft-and-fsync/RaftFsync.tla): fsyncIndex var (:92),
+    # crash-truncation to it (:211-216), split Timeout (:222) +
+    # per-peer RequestVote(i,j) (:234), AdvanceFsyncIndex (:339), and
+    # the three fsync policy constants (:50-52). Implies strict
+    # send-once (:132-134,149-152), no pendingResponse, and
+    # term-mismatch truncation (:441-444).
+    has_fsync: bool = False
+    fsync_leader_before_ae: bool = False  # LeaderFsyncBeforeAppendEntries
+    fsync_leader_quorum: bool = False  # LeaderFsyncBeforeIncludeInQuorum
+    fsync_follower_reply: bool = False  # FollowerFsyncBeforeReply
 
     @property
     def max_term(self) -> int:
@@ -122,6 +135,8 @@ def _build_layout(p: RaftParams) -> Layout:
     lay.add("log_value", "per_server", (S, L))
     lay.add("log_len", "per_server", (S,))
     lay.add("commitIndex", "per_server", (S,))
+    if p.has_fsync:
+        lay.add("fsyncIndex", "per_server", (S,))  # RaftFsync.tla:92,117
     lay.add("nextIndex", "per_server_pair", (S, S))
     lay.add("matchIndex", "per_server_pair", (S, S))
     if p.has_pending_response:
@@ -185,10 +200,20 @@ class RaftModel:
         # message-receipt disjuncts are mutually exclusive per record, so
         # they fuse into one kernel per slot (rank resolved dynamically).
         self.bindings: list[tuple[str, tuple]] = []
+        self._ae_pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
         for i in range(S):
             self.bindings.append(("Restart", (i,)))
-        for i in range(S):
-            self.bindings.append(("RequestVote", (i,)))
+        if params.has_fsync:
+            # RaftFsync Next order (RaftFsync.tla:522-536): Timeout is split
+            # from the per-peer RequestVote(i,j), and AdvanceFsyncIndex
+            # follows AppendEntries.
+            for i in range(S):
+                self.bindings.append(("Timeout", (i,)))
+            for ij in self._ae_pairs:
+                self.bindings.append(("RequestVotePair", ij))
+        else:
+            for i in range(S):
+                self.bindings.append(("RequestVote", (i,)))
         for i in range(S):
             self.bindings.append(("BecomeLeader", (i,)))
         for i in range(S):
@@ -196,9 +221,11 @@ class RaftModel:
                 self.bindings.append(("ClientRequest", (i, v)))
         for i in range(S):
             self.bindings.append(("AdvanceCommitIndex", (i,)))
-        self._ae_pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
         for ij in self._ae_pairs:
             self.bindings.append(("AppendEntries", ij))
+        if params.has_fsync:
+            for i in range(S):
+                self.bindings.append(("AdvanceFsyncIndex", (i,)))
         for m in range(M):
             self.bindings.append(("HandleMessage", (m,)))
         self.A = len(self.bindings)
@@ -249,7 +276,10 @@ class RaftModel:
     # Each returns (valid, succ_vec, rank, overflow).
 
     def _restart(self, s, i):
-        """Restart(i) — Raft.tla:226-235 (FlexibleRaft.tla:200-208)."""
+        """Restart(i) — Raft.tla:226-235 (FlexibleRaft.tla:200-208).
+        RaftFsync (RaftFsync.tla:203-218) additionally truncates the log
+        back to fsyncIndex[i] — all three IF arms reduce to
+        Len' = min(Len, fsyncIndex)."""
         p, S = self.p, self.p.n_servers
         d = self._dec(s)
         valid = d["restartCtr"] < p.max_restarts
@@ -263,8 +293,64 @@ class RaftModel:
         )
         if p.has_pending_response:
             upd["pendingResponse"] = d["pendingResponse"].at[i].set(0)
+        if p.has_fsync:
+            new_ll = jnp.minimum(d["log_len"][i], d["fsyncIndex"][i])
+            keep = jnp.arange(p.max_log, dtype=jnp.int32) < new_ll
+            upd["log_term"] = d["log_term"].at[i].set(
+                jnp.where(keep, d["log_term"][i], 0)
+            )
+            upd["log_value"] = d["log_value"].at[i].set(
+                jnp.where(keep, d["log_value"][i], 0)
+            )
+            upd["log_len"] = d["log_len"].at[i].set(new_ll)
         succ = self._asm(d, **upd)
         return valid, succ, jnp.int32(R_RESTART), jnp.asarray(False)
+
+    def _timeout(self, s, i):
+        """Timeout(i) — RaftFsync.tla:222-230: start an election without
+        sending (RequestVote(i,j) sends per peer separately)."""
+        p = self.p
+        d = self._dec(s)
+        st_i = d["state"][i]
+        valid = (d["electionCtr"] < p.max_elections) & (
+            (st_i == FOLLOWER) | (st_i == CANDIDATE)
+        )
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(CANDIDATE),
+            currentTerm=d["currentTerm"].at[i].set(d["currentTerm"][i] + 1),
+            votedFor=d["votedFor"].at[i].set(i + 1),
+            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
+            electionCtr=d["electionCtr"] + 1,
+        )
+        return valid, succ, jnp.int32(R_TIMEOUT), jnp.asarray(False)
+
+    def _request_vote_pair(self, s, i, j):
+        """RequestVote(i, j) — RaftFsync.tla:234-243: candidate i sends one
+        send-once RequestVoteRequest (at its current term) to peer j."""
+        d = self._dec(s)
+        valid = d["state"][i] == CANDIDATE
+        khi, klo = self._pack(
+            mtype=RVREQ,
+            mterm=d["currentTerm"][i],
+            mlastLogTerm=self._last_term(d, i),
+            mlastLogIndex=d["log_len"][i],
+            msource=i,
+            mdest=j,
+        )
+        hi, lo, cnt, existed, ovf = bag.bag_put(
+            d["msg_hi"], d["msg_lo"], d["msg_cnt"], khi, klo
+        )
+        valid &= ~existed  # Send (RaftFsync.tla:132-134) is send-once
+        succ = self._asm(d, msg_hi=hi, msg_lo=lo, msg_cnt=cnt)
+        return valid, succ, jnp.int32(R_REQUESTVOTE), ovf & valid
+
+    def _advance_fsync_index(self, s, i):
+        """AdvanceFsyncIndex(i) — RaftFsync.tla:339-343."""
+        d = self._dec(s)
+        valid = d["fsyncIndex"][i] < d["log_len"][i]
+        succ = self._asm(d, fsyncIndex=d["fsyncIndex"].at[i].add(1))
+        return valid, succ, jnp.int32(R_ADVANCEFSYNC), jnp.asarray(False)
 
     def _request_vote(self, s, i):
         """RequestVote(i) — Raft.tla:242-257 (fused Timeout+RequestVote)."""
@@ -358,10 +444,13 @@ class RaftModel:
         ci_i = d["commitIndex"][i]
         match_row = d["matchIndex"][i]  # [S]
         idxs = jnp.arange(1, L + 1, dtype=jnp.int32)  # candidate indexes
-        # Agree(index) = {i} u {k : matchIndex[i][k] >= index} (Raft.tla:323-324)
-        agree = (jnp.arange(S, dtype=jnp.int32)[None, :] == i) | (
-            match_row[None, :] >= idxs[:, None]
-        )
+        # Agree(index) = {i} u {k : matchIndex[i][k] >= index} (Raft.tla:323-324).
+        # RaftFsync (RaftFsync.tla:313-315): when LeaderFsyncBeforeIncludeInQuorum
+        # and index > fsyncIndex[i], the leader excludes itself.
+        self_in = jnp.arange(S, dtype=jnp.int32)[None, :] == i
+        if p.has_fsync and p.fsync_leader_quorum:
+            self_in = self_in & (idxs[:, None] <= d["fsyncIndex"][i])
+        agree = self_in | (match_row[None, :] >= idxs[:, None])
         agree_cnt = jnp.sum(agree, axis=1)
         if p.replication_quorum is not None:
             # FlexibleRaft.tla:296: Cardinality(Agree) >= ReplicationQuorumSize
@@ -404,6 +493,9 @@ class RaftModel:
         lv_row = d["log_value"][i]
         prev_term = jnp.where(prev_idx > 0, lt_row[jnp.clip(prev_idx - 1, 0, L - 1)], 0)
         last_entry = jnp.minimum(d["log_len"][i], ni_ij)  # Min (Raft.tla:273)
+        if p.has_fsync and p.fsync_leader_before_ae:
+            # LeaderFsyncBeforeAppendEntries gate (RaftFsync.tla:261-263)
+            valid &= d["fsyncIndex"][i] >= last_entry
         nent = (last_entry >= ni_ij).astype(jnp.int32)  # <=1 entry
         epos = jnp.clip(ni_ij - 1, 0, L - 1)
         eterm = jnp.where(nent > 0, lt_row[epos], 0)
@@ -592,8 +684,7 @@ class RaftModel:
         hi3, lo3, cnt3, ex3, ovf3 = reply(achi, aclo)
         if p.strict_send_once:
             b_accept &= ~ex3
-        s_accept = self._asm(
-            d,
+        upd_accept = dict(
             state=d["state"].at[dst].set(FOLLOWER),
             commitIndex=d["commitIndex"].at[dst].set(u("mcommitIndex")),
             log_term=d["log_term"].at[dst].set(nlt),
@@ -603,6 +694,11 @@ class RaftModel:
             msg_lo=lo3,
             msg_cnt=cnt3,
         )
+        if p.has_fsync and p.fsync_follower_reply:
+            # FollowerFsyncBeforeReply: fsyncIndex := Len(new_log)
+            # (RaftFsync.tla:468-470), even when the log didn't change.
+            upd_accept["fsyncIndex"] = d["fsyncIndex"].at[dst].set(new_ll)
+        s_accept = self._asm(d, **upd_accept)
 
         # --- HandleAppendEntriesResponse (Raft.tla:490-505)
         b_aeresp = recv & (mtype == AERESP) & (mterm == ct_dst)
@@ -655,17 +751,25 @@ class RaftModel:
         p = self.p
         S, V, M = p.n_servers, p.n_values, p.msg_slots
         iota_s = jnp.arange(S, dtype=jnp.int32)
+        ae_i = jnp.asarray([ij[0] for ij in self._ae_pairs], jnp.int32)
+        ae_j = jnp.asarray([ij[1] for ij in self._ae_pairs], jnp.int32)
         outs = []
         outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
+        if p.has_fsync:
+            outs.append(jax.vmap(lambda i: self._timeout(s, i))(iota_s))
+            outs.append(
+                jax.vmap(lambda i, j: self._request_vote_pair(s, i, j))(ae_i, ae_j)
+            )
+        else:
+            outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
         outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
         cr_i = jnp.repeat(iota_s, V)
         cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
         outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
         outs.append(jax.vmap(lambda i: self._advance_commit_index(s, i))(iota_s))
-        ae_i = jnp.asarray([ij[0] for ij in self._ae_pairs], jnp.int32)
-        ae_j = jnp.asarray([ij[1] for ij in self._ae_pairs], jnp.int32)
         outs.append(jax.vmap(lambda i, j: self._append_entries(s, i, j))(ae_i, ae_j))
+        if p.has_fsync:
+            outs.append(jax.vmap(lambda i: self._advance_fsync_index(s, i))(iota_s))
         outs.append(
             jax.vmap(lambda m: self._handle_message(s, m))(jnp.arange(M, dtype=jnp.int32))
         )
@@ -790,7 +894,12 @@ class RaftModel:
             if int(hi[k]) == int(EMPTY):
                 continue
             msgs[self.decode_msg(int(hi[k]), int(lo[k]))] = int(cnt[k])
-        return {
+        extra = (
+            {"fsyncIndex": tuple(int(x) for x in g("fsyncIndex"))}
+            if p.has_fsync
+            else {}
+        )
+        return extra | {
             "currentTerm": tuple(int(x) for x in g("currentTerm")),
             "state": tuple(int(x) for x in g("state")),
             "votedFor": tuple(int(x) - 1 if x > 0 else None for x in g("votedFor")),
@@ -883,6 +992,8 @@ class RaftModel:
         vec[lay.sl("log_value")] = lv.reshape(-1)
         vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
         vec[lay.sl("commitIndex")] = st["commitIndex"]
+        if p.has_fsync:
+            vec[lay.sl("fsyncIndex")] = st["fsyncIndex"]
         vec[lay.sl("nextIndex")] = np.asarray(st["nextIndex"]).reshape(-1)
         vec[lay.sl("matchIndex")] = np.asarray(st["matchIndex"]).reshape(-1)
         if p.has_pending_response:

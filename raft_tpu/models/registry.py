@@ -31,6 +31,21 @@ def _require_int(cfg: Cfg, name: str) -> int:
     return v
 
 
+def _require_bool(cfg: Cfg, name: str) -> bool:
+    if name not in cfg.constants:
+        raise CfgError(f"{cfg.path}: required constant {name} is missing")
+    v = cfg.constants[name]
+    if not isinstance(v, bool):
+        raise CfgError(f"{cfg.path}: constant {name} must be TRUE/FALSE, got {v!r}")
+    return v
+
+
+def _check_invariants(cfg: Cfg, model) -> None:
+    unknown = [i for i in cfg.invariants if i not in model.invariants]
+    if unknown:
+        raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
+
+
 def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
     """standard-raft/Raft.tla + Raft.cfg."""
     servers = cfg.server_like("Server")
@@ -43,9 +58,7 @@ def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
         msg_slots=msg_slots,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
-    unknown = [i for i in cfg.invariants if i not in model.invariants]
-    if unknown:
-        raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
+    _check_invariants(cfg, model)
     return CheckSetup(
         model=model,
         invariants=tuple(cfg.invariants),
@@ -76,9 +89,41 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     model.name = "FlexibleRaft"
-    unknown = [i for i in cfg.invariants if i not in model.invariants]
-    if unknown:
-        raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
+def build_raft_fsync(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+    """raft-and-fsync/RaftFsync.tla + RaftFsync.cfg: core Raft plus
+    fsyncIndex durability (RaftFsync.tla:92), crash-truncation restart
+    (:203-218), split Timeout/RequestVote (:222-243), AdvanceFsyncIndex
+    (:339), three fsync policy constants (:50-52), strictly send-once
+    messaging (:132-152), and no pendingResponse flow control."""
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = RaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        msg_slots=msg_slots,
+        strict_send_once=True,
+        has_pending_response=False,
+        trunc_term_mismatch=True,
+        has_fsync=True,
+        fsync_leader_before_ae=_require_bool(cfg, "LeaderFsyncBeforeAppendEntries"),
+        fsync_leader_quorum=_require_bool(cfg, "LeaderFsyncBeforeIncludeInQuorum"),
+        fsync_follower_reply=_require_bool(cfg, "FollowerFsyncBeforeReply"),
+    )
+    model = RaftModel(params, server_names=servers, value_names=values)
+    model.name = "RaftFsync"
+    _check_invariants(cfg, model)
     return CheckSetup(
         model=model,
         invariants=tuple(cfg.invariants),
@@ -91,6 +136,7 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
 BUILDERS = {
     "Raft": build_raft,
     "FlexibleRaft": build_flexible_raft,
+    "RaftFsync": build_raft_fsync,
 }
 
 

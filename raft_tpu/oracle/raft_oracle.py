@@ -34,6 +34,10 @@ def oracle_for(params) -> "RaftOracle":
         strict_send_once=params.strict_send_once,
         has_pending_response=params.has_pending_response,
         trunc_term_mismatch=params.trunc_term_mismatch,
+        has_fsync=params.has_fsync,
+        fsync_leader_before_ae=params.fsync_leader_before_ae,
+        fsync_leader_quorum=params.fsync_leader_quorum,
+        fsync_follower_reply=params.fsync_follower_reply,
     )
 
 
@@ -63,6 +67,10 @@ class RaftOracle:
         strict_send_once: bool = False,
         has_pending_response: bool = True,
         trunc_term_mismatch: bool = False,
+        has_fsync: bool = False,
+        fsync_leader_before_ae: bool = False,
+        fsync_leader_quorum: bool = False,
+        fsync_follower_reply: bool = False,
     ):
         self.S = n_servers
         self.V = n_values
@@ -73,13 +81,18 @@ class RaftOracle:
         self.strict_send_once = strict_send_once
         self.has_pending_response = has_pending_response
         self.trunc_term_mismatch = trunc_term_mismatch
+        self.has_fsync = has_fsync
+        self.fsync_leader_before_ae = fsync_leader_before_ae
+        self.fsync_leader_quorum = fsync_leader_quorum
+        self.fsync_follower_reply = fsync_follower_reply
 
     # ---------- state helpers ----------
 
     def init_state(self) -> dict:
-        """Init — Raft.tla:213-218."""
+        """Init — Raft.tla:213-218 (RaftFsync.tla:189-194 adds fsyncIndex)."""
         S, V = self.S, self.V
-        return {
+        extra = {"fsyncIndex": (0,) * S} if self.has_fsync else {}
+        return extra | {
             "currentTerm": (1,) * S,
             "state": (FOLLOWER,) * S,
             "votedFor": (None,) * S,
@@ -192,10 +205,23 @@ class RaftOracle:
             s2 = self.restart(st, i)
             if s2 is not None:
                 out.append((f"Restart({i})", s2))
-        for i in range(S):
-            s2 = self.request_vote(st, i)
-            if s2 is not None:
-                out.append((f"RequestVote({i})", s2))
+        if self.has_fsync:
+            # RaftFsync Next order (RaftFsync.tla:522-536)
+            for i in range(S):
+                s2 = self.timeout(st, i)
+                if s2 is not None:
+                    out.append((f"Timeout({i})", s2))
+            for i in range(S):
+                for j in range(S):
+                    if i != j:
+                        s2 = self.request_vote_pair(st, i, j)
+                        if s2 is not None:
+                            out.append((f"RequestVote({i},{j})", s2))
+        else:
+            for i in range(S):
+                s2 = self.request_vote(st, i)
+                if s2 is not None:
+                    out.append((f"RequestVote({i})", s2))
         for i in range(S):
             s2 = self.become_leader(st, i)
             if s2 is not None:
@@ -215,6 +241,11 @@ class RaftOracle:
                     s2 = self.append_entries(st, i, j)
                     if s2 is not None:
                         out.append((f"AppendEntries({i},{j})", s2))
+        if self.has_fsync:
+            for i in range(S):
+                s2 = self.advance_fsync_index(st, i)
+                if s2 is not None:
+                    out.append((f"AdvanceFsyncIndex({i})", s2))
         for m in self._domain(st):
             s2 = self.update_term(st, m)
             if s2 is not None:
@@ -242,10 +273,22 @@ class RaftOracle:
         return out
 
     def restart(self, st, i):
-        """Restart(i) — Raft.tla:226-235."""
+        """Restart(i) — Raft.tla:226-235; RaftFsync.tla:203-218 truncates
+        the log to fsyncIndex."""
         if st["restartCtr"] >= self.max_restarts:
             return None
         S = self.S
+        extra = {}
+        if self.has_fsync:
+            fi = st["fsyncIndex"][i]
+            log_i = st["log"][i]
+            if fi == 0:
+                new_log = ()
+            elif len(log_i) > 0 and len(log_i) > fi:
+                new_log = log_i[:fi]
+            else:
+                new_log = log_i
+            extra["log"] = self._set(st["log"], i, new_log)
         return self._with(
             st,
             state=self._set(st["state"], i, FOLLOWER),
@@ -255,6 +298,47 @@ class RaftOracle:
             pendingResponse=self._set(st["pendingResponse"], i, (False,) * S),
             commitIndex=self._set(st["commitIndex"], i, 0),
             restartCtr=st["restartCtr"] + 1,
+            **extra,
+        )
+
+    def timeout(self, st, i):
+        """Timeout(i) — RaftFsync.tla:222-230."""
+        if st["electionCtr"] >= self.max_elections:
+            return None
+        if st["state"][i] not in (FOLLOWER, CANDIDATE):
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, CANDIDATE),
+            currentTerm=self._set(st["currentTerm"], i, st["currentTerm"][i] + 1),
+            votedFor=self._set(st["votedFor"], i, i),
+            votesGranted=self._set(st["votesGranted"], i, frozenset({i})),
+            electionCtr=st["electionCtr"] + 1,
+        )
+
+    def request_vote_pair(self, st, i, j):
+        """RequestVote(i, j) — RaftFsync.tla:234-243."""
+        if i == j or st["state"][i] != CANDIDATE:
+            return None
+        m = rec(
+            mtype="RequestVoteRequest",
+            mterm=st["currentTerm"][i],
+            mlastLogTerm=_last_term(st["log"][i]),
+            mlastLogIndex=len(st["log"][i]),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._send_once(self._msgs(st), m)  # Send (RaftFsync.tla:132-134)
+        if msgs is None:
+            return None
+        return self._with(st, messages=frozenset(msgs.items()))
+
+    def advance_fsync_index(self, st, i):
+        """AdvanceFsyncIndex(i) — RaftFsync.tla:339-343."""
+        if st["fsyncIndex"][i] >= len(st["log"][i]):
+            return None
+        return self._with(
+            st, fsyncIndex=self._set(st["fsyncIndex"], i, st["fsyncIndex"][i] + 1)
         )
 
     def request_vote(self, st, i):
@@ -331,10 +415,20 @@ class RaftOracle:
                 return n >= self.replication_quorum  # FlexibleRaft.tla:296
             return 2 * n > S
 
+        def _agree(idx: int) -> set:
+            """Agree(index) — Raft.tla:323-324; RaftFsync.tla:313-315
+            excludes the leader itself above its fsyncIndex."""
+            base = {k for k in range(S) if mi[k] >= idx}
+            if (
+                self.has_fsync
+                and self.fsync_leader_quorum
+                and idx > st["fsyncIndex"][i]
+            ):
+                return base
+            return {i} | base
+
         agree_indexes = [
-            idx
-            for idx in range(1, len(log_i) + 1)
-            if _quorum(len({i} | {k for k in range(S) if mi[k] >= idx}))
+            idx for idx in range(1, len(log_i) + 1) if _quorum(len(_agree(idx)))
         ]
         ci = st["commitIndex"][i]
         if agree_indexes and log_i[max(agree_indexes) - 1][0] == st["currentTerm"][i]:
@@ -364,6 +458,10 @@ class RaftOracle:
         prev_term = log_i[prev_index - 1][0] if prev_index > 0 else 0
         last_entry = min(len(log_i), ni)
         entries = tuple(log_i[ni - 1 : last_entry])
+        if self.has_fsync and self.fsync_leader_before_ae:
+            # LeaderFsyncBeforeAppendEntries gate (RaftFsync.tla:261-263)
+            if st["fsyncIndex"][i] < last_entry:
+                return None
         m = rec(
             mtype="AppendEntriesRequest",
             mterm=st["currentTerm"][i],
@@ -520,12 +618,17 @@ class RaftOracle:
         msgs = self._reply(self._msgs(st), resp, m)
         if msgs is None:
             return None
+        extra = {}
+        if self.has_fsync and self.fsync_follower_reply:
+            # fsyncIndex := Len(new_log) (RaftFsync.tla:468-470)
+            extra["fsyncIndex"] = self._set(st["fsyncIndex"], i, len(new_log))
         return self._with(
             st,
             state=self._set(st["state"], i, FOLLOWER),
             commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
             log=self._set(st["log"], i, new_log),
             messages=frozenset(msgs.items()),
+            **extra,
         )
 
     def handle_append_entries_response(self, st, m):
@@ -555,8 +658,9 @@ class RaftOracle:
     # ---------- VIEW + SYMMETRY (Raft.tla:115-116) ----------
 
     def serialize_view(self, st) -> tuple:
-        """Orderable serialization of the VIEW projection (drops aux vars)."""
-        return (
+        """Orderable serialization of the VIEW projection (drops aux vars).
+        RaftFsync's view includes fsyncIndex (RaftFsync.tla:117)."""
+        return ((st["fsyncIndex"],) if self.has_fsync else ()) + (
             st["currentTerm"],
             st["state"],
             tuple(-1 if v is None else v for v in st["votedFor"]),
@@ -594,10 +698,12 @@ class RaftOracle:
             d["mdest"] = sigma[d["mdest"]]
             return rec(**d)
 
+        extra = {"fsyncIndex": prow(st["fsyncIndex"])} if self.has_fsync else {}
         return self._with(
             st,
             currentTerm=prow(st["currentTerm"]),
             state=prow(st["state"]),
+            **extra,
             votedFor=tuple(
                 None if v is None else sigma[v] for v in prow(st["votedFor"])
             ),

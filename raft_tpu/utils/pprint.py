@@ -86,6 +86,11 @@ def format_state(setup, st: dict) -> str:
         "/\\ commitIndex = "
         + _fmt_fun((sv(i), st["commitIndex"][i]) for i in range(S))
     )
+    if "fsyncIndex" in st:  # RaftFsync (RaftFsync.tla:92)
+        lines.append(
+            "/\\ fsyncIndex = "
+            + _fmt_fun((sv(i), st["fsyncIndex"][i]) for i in range(S))
+        )
     for name in ("nextIndex", "matchIndex", "pendingResponse"):
         lines.append(
             f"/\\ {name} = "

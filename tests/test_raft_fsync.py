@@ -1,0 +1,119 @@
+"""RaftFsync differential tests: fsync-variant kernels vs the variant
+oracle across policy combinations, BFS count parity, and reference-cfg
+loading (raft-and-fsync/RaftFsync.tla + RaftFsync.cfg)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.models.raft import RaftModel, RaftParams, cached_model
+from raft_tpu.oracle.raft_oracle import oracle_for
+
+from conftest import collect_states as _collect_states
+
+
+def fsync_params(before_ae: bool, quorum: bool, follower: bool, **kw) -> RaftParams:
+    return RaftParams(
+        n_servers=3,
+        n_values=1,
+        max_elections=kw.pop("max_elections", 1),
+        max_restarts=kw.pop("max_restarts", 1),
+        msg_slots=kw.pop("msg_slots", 24),
+        strict_send_once=True,
+        has_pending_response=False,
+        trunc_term_mismatch=True,
+        has_fsync=True,
+        fsync_leader_before_ae=before_ae,
+        fsync_leader_quorum=quorum,
+        fsync_follower_reply=follower,
+        **kw,
+    )
+
+
+# The reference cfg's policy (RaftFsync.cfg:24-26) plus the two extremes.
+POLICIES = [(False, True, True), (False, False, False), (True, True, True)]
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fsync_successor_sets_match_oracle(policy):
+    params = fsync_params(*policy)
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    states = _collect_states(oracle, max_depth=6, cap=140)
+    vecs = np.stack([model.encode(st) for st in states])
+    succs, valid, rank, ovf = jax.device_get(model.expand(vecs))
+    assert not np.any(valid & ovf)
+    for b, st in enumerate(states):
+        got = sorted(
+            oracle.serialize_full(model.decode(succs[b, a]))
+            for a in range(model.A)
+            if valid[b, a]
+        )
+        want = sorted(oracle.serialize_full(s2) for _l, s2 in oracle.successors(st))
+        assert got == want, f"successor mismatch at state {b} (policy {policy})"
+
+
+def test_fsync_encode_decode_roundtrip():
+    params = fsync_params(False, True, True)
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    for st in _collect_states(oracle, max_depth=5, cap=100):
+        assert model.decode(model.encode(st)) == st
+
+
+def test_fsync_bfs_counts_match_oracle():
+    params = fsync_params(False, True, True, max_elections=2, max_restarts=0)
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    invs = ("LeaderHasAllAckedValues", "NoLogDivergence")
+    checker = BFSChecker(model, invariants=invs, symmetry=True, chunk=256)
+    res = checker.run(max_depth=9)
+    ores = oracle.bfs(invariants=invs, symmetry=True, max_depth=9)
+    assert res.violation is None and ores["violation"] is None
+    assert res.distinct == ores["distinct"]
+    assert res.depth_counts == ores["depth_counts"]
+
+
+def test_fsync_restart_truncates_to_fsync_index():
+    """Crash-restart data loss: log beyond fsyncIndex vanishes
+    (RaftFsync.tla:211-216)."""
+    params = fsync_params(False, False, False, max_restarts=1)
+    oracle = oracle_for(params)
+    st = oracle.init_state()
+    st = dict(
+        st,
+        state=(2, 0, 0),  # leader
+        log=(((1, 0),), (), ()),
+        fsyncIndex=(0, 0, 0),
+    )
+    s2 = oracle.restart(st, 0)
+    assert s2["log"][0] == ()  # fsyncIndex 0 -> empty log
+    st2 = dict(st, fsyncIndex=(1, 0, 0))
+    s3 = oracle.restart(st2, 0)
+    assert s3["log"][0] == ((1, 0),)  # fsynced entry survives
+    model = cached_model(params)
+    for probe in (st, st2):
+        vec = model.encode(probe)
+        succs, valid, rank, _ = jax.device_get(model.expand(vec[None]))
+        restart_cand = 0  # Restart(0) is binding 0
+        assert valid[0, restart_cand]
+        got = model.decode(succs[0, restart_cand])
+        want = oracle.restart(probe, 0)
+        assert oracle.serialize_full(got) == oracle.serialize_full(want)
+
+
+def test_reference_fsync_cfg_loads():
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    cfg = parse_cfg("/root/reference/specifications/raft-and-fsync/RaftFsync.cfg")
+    setup = build_from_cfg(cfg, msg_slots=16)
+    p = setup.model.p
+    assert setup.model.name == "RaftFsync"
+    assert p.has_fsync and not p.fsync_leader_before_ae
+    assert p.fsync_leader_quorum and p.fsync_follower_reply
+    assert p.max_elections == 2 and p.max_restarts == 0
+    assert setup.invariants == ("LeaderHasAllAckedValues", "NoLogDivergence")
+    assert setup.symmetry
