@@ -27,8 +27,15 @@ def main(argv=None):
     )
     ap.add_argument("--max-depth", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
-    ap.add_argument("--msg-slots", type=int, default=48)
+    ap.add_argument("--msg-slots", type=int, default=None,
+                    help="message-bag slot count (default: per-spec)")
     ap.add_argument("--no-symmetry", action="store_true", help="ignore SYMMETRY")
+    ap.add_argument(
+        "--lenient",
+        action="store_true",
+        help="downgrade recoverable cfg bugs (e.g. PullRaft.cfg's undeclared "
+        "v2) to warnings and apply the obvious repair",
+    )
     ap.add_argument(
         "--platform",
         default=os.environ.get("RAFT_TPU_PLATFORM", "auto"),
@@ -50,7 +57,9 @@ def main(argv=None):
     from .models.registry import build_from_cfg
 
     try:
-        cfg = parse_cfg(args.cfg)
+        cfg = parse_cfg(args.cfg, lenient=args.lenient)
+        for diag in cfg.diagnostics:
+            print(f"config warning: {diag}", file=sys.stderr)
         setup = build_from_cfg(cfg, spec=args.spec, msg_slots=args.msg_slots)
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -67,9 +76,9 @@ def main(argv=None):
     )
 
     if args.checker == "oracle":
-        from .oracle.raft_oracle import oracle_for
+        from .models.registry import oracle_for_setup
 
-        oracle = oracle_for(setup.model.p)  # carries all variant knobs
+        oracle = oracle_for_setup(setup)  # carries all variant knobs
         res = oracle.bfs(
             invariants=setup.invariants, symmetry=symmetry, max_depth=args.max_depth
         )

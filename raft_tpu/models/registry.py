@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..utils.cfg import Cfg, CfgError
+from .pull_raft import PullRaftModel, PullRaftParams
 from .raft import RaftModel, RaftParams
 
 
@@ -46,7 +47,7 @@ def _check_invariants(cfg: Cfg, model) -> None:
         raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
 
 
-def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+def build_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     """standard-raft/Raft.tla + Raft.cfg."""
     servers = cfg.server_like("Server")
     values = cfg.server_like("Value")
@@ -55,7 +56,7 @@ def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots,
+        msg_slots=msg_slots or 48,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     _check_invariants(cfg, model)
@@ -68,7 +69,7 @@ def build_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
     )
 
 
-def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+def build_flexible_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     """flexible-raft/FlexibleRaft.tla + FlexibleRaft.cfg: structurally core
     Raft with count-based quorums (FlexibleRaft.tla:262,296), strictly
     send-once messaging (:127-151), no pendingResponse (:109), and
@@ -80,7 +81,7 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots,
+        msg_slots=msg_slots or 48,
         election_quorum=_require_int(cfg, "ElectionQuorumSize"),
         replication_quorum=_require_int(cfg, "ReplicationQuorumSize"),
         strict_send_once=True,
@@ -99,7 +100,7 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
     )
 
 
-def build_raft_fsync(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
+def build_raft_fsync(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     """raft-and-fsync/RaftFsync.tla + RaftFsync.cfg: core Raft plus
     fsyncIndex durability (RaftFsync.tla:92), crash-truncation restart
     (:203-218), split Timeout/RequestVote (:222-243), AdvanceFsyncIndex
@@ -112,7 +113,7 @@ def build_raft_fsync(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots,
+        msg_slots=msg_slots or 48,
         strict_send_once=True,
         has_pending_response=False,
         trunc_term_mismatch=True,
@@ -133,14 +134,67 @@ def build_raft_fsync(cfg: Cfg, msg_slots: int = 48) -> CheckSetup:
     )
 
 
+def _build_pull(cfg: Cfg, msg_slots: int | None, variant2: bool) -> CheckSetup:
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = PullRaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        # pull specs need extra bag headroom: every message type is
+        # send-once, so count-0 records pile up across a behavior
+        msg_slots=msg_slots or 64,
+        variant2=variant2,
+    )
+    model = PullRaftModel(params, server_names=servers, value_names=values)
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
+def build_pull_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """pull-raft/PullRaft.tla + PullRaft.cfg (note: the reference cfg
+    references the undeclared model value `v2`, PullRaft.cfg:9-11 — parse
+    with lenient=True to diagnose-and-repair)."""
+    return _build_pull(cfg, msg_slots, variant2=False)
+
+
+def build_pull_raft_v2(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """pull-raft/PullRaftVariant2.tla + PullRaftVariant2.cfg (same cfg bug)."""
+    return _build_pull(cfg, msg_slots, variant2=True)
+
+
 BUILDERS = {
     "Raft": build_raft,
     "FlexibleRaft": build_flexible_raft,
     "RaftFsync": build_raft_fsync,
+    "PullRaft": build_pull_raft,
+    "PullRaftVariant2": build_pull_raft_v2,
 }
 
 
-def build_from_cfg(cfg: Cfg, spec: str | None = None, msg_slots: int = 48) -> CheckSetup:
+def oracle_for_setup(setup: CheckSetup):
+    """Pure-Python differential oracle matching the setup's model params."""
+    p = setup.model.p
+    if isinstance(p, PullRaftParams):
+        from ..oracle.pull_oracle import PullRaftOracle
+
+        return PullRaftOracle(
+            p.n_servers, p.n_values, p.max_elections, p.max_restarts,
+            variant2=p.variant2,
+        )
+    from ..oracle.raft_oracle import oracle_for
+
+    return oracle_for(p)
+
+
+def build_from_cfg(cfg: Cfg, spec: str | None = None, msg_slots: int | None = None) -> CheckSetup:
     import os
 
     name = spec or os.path.splitext(os.path.basename(cfg.path))[0]

@@ -45,6 +45,9 @@ class Cfg:
     specification: str | None = None
     # declaration order of model values (TLC set/order determinism)
     model_values: list[str] = field(default_factory=list)
+    # recoverable cfg bugs found while parsing (e.g. PullRaft.cfg's
+    # undeclared `v2`); parse_cfg raises on these unless lenient=True
+    diagnostics: list[str] = field(default_factory=list)
 
     def server_like(self, name: str) -> list[str]:
         v = self.constants.get(name)
@@ -75,7 +78,11 @@ def _strip_comment(line: str) -> str:
     return line[:i] if i >= 0 else line
 
 
-def parse_cfg(path: str, text: str | None = None) -> Cfg:
+def parse_cfg(path: str, text: str | None = None, lenient: bool = False) -> Cfg:
+    """Parse a TLC cfg. ``lenient=True`` downgrades recoverable cfg bugs
+    (see Cfg.diagnostics) from errors to recorded diagnostics, applying the
+    obvious repair — e.g. ``PullRaft.cfg:9-11`` uses ``v2`` in the Value set
+    without declaring it as a model value; the repair declares it."""
     if text is None:
         with open(path) as f:
             text = f.read()
@@ -133,6 +140,8 @@ def parse_cfg(path: str, text: str | None = None) -> Cfg:
         elif section is None:
             raise CfgError(f"{path}: content before any section keyword: {line!r}")
     flush_assignment(pending)
+    if cfg.diagnostics and not lenient:
+        raise CfgError("; ".join(cfg.diagnostics))
     return cfg
 
 
@@ -153,10 +162,14 @@ def _parse_value(cfg: Cfg, name: str, rhs: str, path: str):
                 continue
             mv = _lookup_model_value(cfg, t)
             if mv is None:
-                raise CfgError(
+                cfg.diagnostics.append(
                     f"{path}: set {name} references undeclared model value {t!r} "
-                    f"(declared: {', '.join(cfg.model_values) or 'none'})"
+                    f"(declared: {', '.join(cfg.model_values) or 'none'}); "
+                    f"lenient mode repairs this by declaring it"
                 )
+                mv = ModelValue(t)
+                cfg.constants[t] = mv
+                cfg.model_values.append(t)
             out.append(mv)
         return tuple(out)
     if re.fullmatch(r"-?\d+", rhs):

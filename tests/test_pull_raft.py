@@ -1,0 +1,147 @@
+"""PullRaft / PullRaftVariant2 differential tests: the TPU kernels vs the
+independent oracle interpreter (pull-raft/PullRaft.tla, 631 lines;
+PullRaftVariant2.tla, 648 lines), BFS count parity, reference-cfg loading
+with the documented `v2` diagnosis (PullRaft.cfg:9-11)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.models.pull_raft import PullRaftModel, PullRaftParams, cached_model
+from raft_tpu.oracle.pull_oracle import PullRaftOracle, last_common_entry
+
+from conftest import collect_states as _collect_states
+
+
+def oracle_for(p: PullRaftParams) -> PullRaftOracle:
+    return PullRaftOracle(
+        p.n_servers, p.n_values, p.max_elections, p.max_restarts, variant2=p.variant2
+    )
+
+
+PARAMS = [
+    PullRaftParams(n_servers=3, n_values=1, max_elections=2, max_restarts=0,
+                   msg_slots=40),
+    PullRaftParams(n_servers=3, n_values=1, max_elections=2, max_restarts=0,
+                   msg_slots=40, variant2=True),
+    PullRaftParams(n_servers=3, n_values=2, max_elections=2, max_restarts=1,
+                   msg_slots=48, variant2=True),
+]
+
+
+@pytest.mark.parametrize("params", PARAMS)
+def test_successor_sets_match_oracle(params):
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    states = _collect_states(oracle, max_depth=7, cap=140)
+    vecs = np.stack([model.encode(st) for st in states])
+    succs, valid, rank, ovf = jax.device_get(model.expand(vecs))
+    assert not np.any(valid & ovf)
+    for b, st in enumerate(states):
+        got = sorted(
+            oracle.serialize_full(model.decode(succs[b, a]))
+            for a in range(model.A)
+            if valid[b, a]
+        )
+        want = sorted(oracle.serialize_full(s2) for _l, s2 in oracle.successors(st))
+        assert got == want, f"successor mismatch at state {b} ({model.name})"
+
+
+@pytest.mark.parametrize("params", PARAMS[:2])
+def test_encode_decode_roundtrip(params):
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    for st in _collect_states(oracle, max_depth=6, cap=100):
+        assert model.decode(model.encode(st)) == st
+
+
+@pytest.mark.parametrize("variant2", [False, True])
+def test_bfs_counts_match_oracle(variant2):
+    params = PullRaftParams(
+        n_servers=3, n_values=1, max_elections=1, max_restarts=0, msg_slots=32,
+        variant2=variant2,
+    )
+    model = cached_model(params)
+    oracle = oracle_for(params)
+    invs = ("LeaderHasAllAckedValues", "NoLogDivergence")
+    checker = BFSChecker(model, invariants=invs, symmetry=True, chunk=256)
+    res = checker.run(max_depth=10)
+    ores = oracle.bfs(invariants=invs, symmetry=True, max_depth=10)
+    assert res.violation is None and ores["violation"] is None
+    assert res.distinct == ores["distinct"]
+    assert res.depth_counts == ores["depth_counts"]
+    assert res.total == ores["total"]
+
+
+def test_last_common_entry_matches_reference_cases():
+    """LastCommonEntry (PullRaft.tla:211-226): term precedence, index
+    tiebreak, empty-log and no-common cases."""
+    # leader log: terms [1, 1, 2, 3]
+    log = ((1, 0), (1, 1), (2, 0), (3, 1))
+    assert last_common_entry(log, 4, 3) == (4, 3)  # exact last
+    assert last_common_entry(log, 2, 1) == (2, 1)  # equal-term prefix
+    assert last_common_entry(log, 9, 1) == (2, 1)  # term cap beats index
+    assert last_common_entry(log, 1, 2) == (2, 1)  # (3,2)? no: entry3 term2 idx3>1 -> (2,1)
+    assert last_common_entry(log, 4, 9) == (4, 3)  # everything below
+    assert last_common_entry((), 3, 2) == (0, 0)  # empty log
+    assert last_common_entry(log, 0, 0) == (0, 0)  # nothing at-or-below
+
+
+def test_pull_flow_reaches_commit():
+    """End-to-end protocol sanity: directed election + pull + commit path.
+
+    Note the spec property this path must respect: AcceptPullEntriesRequest
+    requires an entry BEYOND the follower's last (PullRaft.tla:470
+    `index <= Len(log[i])`), so the leader needs |Value| >= 2 entries before
+    a follower's matchIndex can reach 1 and anything can commit — commit is
+    unreachable in the 1-value model."""
+    params = PullRaftParams(
+        n_servers=3, n_values=2, max_elections=1, max_restarts=0, msg_slots=32
+    )
+    oracle = oracle_for(params)
+    st = oracle.init_state()
+
+    def step(label_prefix):
+        nonlocal st
+        for label, s2 in oracle.successors(st):
+            if label.startswith(label_prefix):
+                st = s2
+                return
+        raise AssertionError(f"no successor matching {label_prefix!r}")
+
+    step("RequestVote(0)")
+    step("UpdateTerm")  # recipient fences to term 2 first (two-step receipt)
+    step("HandleRequestVoteRequest")  # the fenced server grants
+    step("HandleRequestVoteResponse")
+    step("BecomeLeader(0)")
+    step("ClientRequest(0,0)")
+    step("ClientRequest(0,1)")
+    step("SendPullEntriesRequest(1,0)")
+    step("AcceptPullEntriesRequest")  # entry 1 to follower 1
+    step("HandleSuccessPullEntriesResponse")
+    step("SendPullEntriesRequest(1,0)")  # now at lastIndex=1
+    step("AcceptPullEntriesRequest")  # matchIndex[0][1]=1 -> commit idx 1
+    assert st["commitIndex"][0] == 1
+    assert st["acked"][0] is True
+
+
+def test_reference_pull_cfgs_load_with_diagnosis():
+    from raft_tpu.utils.cfg import CfgError, parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    for name in ("PullRaft", "PullRaftVariant2"):
+        path = f"/root/reference/specifications/pull-raft/{name}.cfg"
+        # strict parse must surface the documented cfg bug
+        with pytest.raises(CfgError, match="undeclared model value 'v2'"):
+            parse_cfg(path)
+        cfg = parse_cfg(path, lenient=True)
+        assert len(cfg.diagnostics) == 1
+        setup = build_from_cfg(cfg, msg_slots=16)
+        assert setup.model.name == name
+        assert setup.model.p.n_servers == 3
+        assert setup.model.p.n_values == 2  # after repair
+        assert setup.model.p.variant2 == (name == "PullRaftVariant2")
+        assert setup.invariants == ("LeaderHasAllAckedValues", "NoLogDivergence")
+        assert setup.symmetry
