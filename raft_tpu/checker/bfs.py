@@ -77,7 +77,7 @@ class BFSChecker:
         self.invariants = tuple(invariants)
         self.chunk = chunk
         self.check_deadlock = check_deadlock
-        self.canon = Canonicalizer(model.layout, model.packer, symmetry=symmetry)
+        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         self._expand = model.expand
         self._fps = self.canon.fingerprints
         # journal: per distinct state (beyond init): parent global id + candidate

@@ -1,0 +1,1196 @@
+"""TPU lowering of the Kafka KRaft spec.
+
+Reference: ``/root/reference/specifications/pull-raft/KRaft.tla`` (961
+lines). Every action kernel cites the TLA+ lines it lowers. The lowering is
+*not* a translation: actions become branchless, ``vmap``-able successor
+kernels over a packed int32 state vector.
+
+Structural notes:
+  - five server states + IllegalState (``KRaft.tla:69,87``) encoded as a
+    small-integer enum; the QuorumState transition machine
+    (``HasConsistentLeader:316``, ``MaybeTransition:351``,
+    ``MaybeHandleCommonResponse:369``) is a branchless select chain;
+  - ``pendingFetch`` (``KRaft.tla:123``) holds the exact FetchRequest the
+    follower sent; its ``msource`` is the row index, so it decomposes into
+    four plain per-server lanes (epoch/offset/lastFetchedEpoch/dest) with
+    epoch > 0 doubling as the non-Nil flag;
+  - FetchResponses embed the request as a ``correlation`` field
+    (``KRaft.tla:649``); the request's source/dest are the response's
+    dest/source, so only its three scalar fields pack into the key;
+  - the ``Reply`` anti-cycle rule — a FetchResponse may not be duplicated
+    (``KRaft.tla:220-227``) — becomes ``valid &= ~existed``;
+  - epochs live in [1, 1+MaxElections] (only ``RequestVote:439`` mints);
+    per-server log length is bounded by |Value| (``acked[v] = Nil`` gate,
+    ``KRaft.tla:596``); quorums are popcount thresholds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bag
+from ..ops.packing import EMPTY, BitPacker, bits_for
+from .base import Layout
+
+# state[i] enum, shared with oracle/kraft_oracle.py (KRaft.tla:69,87)
+UNATTACHED, VOTED, FOLLOWER, CANDIDATE, LEADER, ILLEGAL = range(6)
+NIL = 0  # votedFor/leader Nil; server i stored as i+1
+ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
+
+# mtype (KRaft.tla:75-78); BeginQuorumResponse records are sent but never
+# received (header note, KRaft.tla:17-21)
+RVREQ, RVRESP, BQREQ, BQRESP, FETCHREQ, FETCHRESP = 1, 2, 3, 4, 5, 6
+# merror (KRaft.tla:84); 0 = Nil
+E_NONE, E_FENCED, E_NOTLEADER, E_UNKNOWN = 0, 1, 2, 3
+# mresult (KRaft.tla:81); 0 = absent (non-fetch-response records)
+R_NONE, R_OK, R_NOTOK, R_DIVERGING = 0, 1, 2, 3
+
+# Next-disjunct order (KRaft.tla:823-840), for trace labels.
+(
+    K_RESTART,
+    K_REQUESTVOTE,
+    K_HANDLE_RVREQ,
+    K_HANDLE_RVRESP,
+    K_BECOMELEADER,
+    K_CLIENTREQUEST,
+    K_REJECT_FETCH,
+    K_DIVERGING_FETCH,
+    K_ACCEPT_FETCH,
+    K_HANDLE_BQREQ,
+    K_SENDFETCH,
+    K_HANDLE_FETCH_OK,
+    K_HANDLE_FETCH_DIV,
+    K_HANDLE_FETCH_ERR,
+) = range(14)
+
+ACTION_NAMES = [
+    "Restart",
+    "RequestVote",
+    "HandleRequestVoteRequest",
+    "HandleRequestVoteResponse",
+    "BecomeLeader",
+    "ClientRequest",
+    "RejectFetchRequest",
+    "DivergingFetchRequest",
+    "AcceptFetchRequest",
+    "HandleBeginQuorumRequest",
+    "SendFetchRequest",
+    "HandleSuccessFetchResponse",
+    "HandleDivergingFetchResponse",
+    "HandleErrorFetchResponse",
+]
+
+STATE_NAMES = {
+    UNATTACHED: "Unattached",
+    VOTED: "Voted",
+    FOLLOWER: "Follower",
+    CANDIDATE: "Candidate",
+    LEADER: "Leader",
+    ILLEGAL: "IllegalState",
+}
+MTYPE_NAMES = {
+    RVREQ: "RequestVoteRequest",
+    RVRESP: "RequestVoteResponse",
+    BQREQ: "BeginQuorumRequest",
+    BQRESP: "BeginQuorumResponse",
+    FETCHREQ: "FetchRequest",
+    FETCHRESP: "FetchResponse",
+}
+ERROR_NAMES = {E_NONE: None, E_FENCED: "FencedLeaderEpoch",
+               E_NOTLEADER: "NotLeader", E_UNKNOWN: "UnknownLeader"}
+RESULT_NAMES = {R_OK: "Ok", R_NOTOK: "NotOk", R_DIVERGING: "Diverging"}
+
+
+@dataclass(frozen=True)
+class KRaftParams:
+    n_servers: int
+    n_values: int
+    max_elections: int
+    max_restarts: int
+    msg_slots: int = 64
+
+    @property
+    def max_epoch(self) -> int:
+        return 1 + self.max_elections
+
+    @property
+    def max_log(self) -> int:
+        return max(1, self.n_values)
+
+
+def _build_layout(p: KRaftParams) -> Layout:
+    S, V, L, M = p.n_servers, p.n_values, p.max_log, p.msg_slots
+    lay = Layout(S)
+    # VIEW (KRaft.tla:154) = messages, serverVars, candidateVars,
+    # leaderVars, logVars AND acked; only electionCtr/restartCtr are aux.
+    lay.add("currentEpoch", "per_server", (S,))
+    lay.add("state", "per_server", (S,))
+    lay.add("votedFor", "per_server_val", (S,))
+    lay.add("leader", "per_server_val", (S,))
+    # pendingFetch (KRaft.tla:123) decomposed; pf_epoch > 0 <=> non-Nil
+    lay.add("pf_epoch", "per_server", (S,))
+    lay.add("pf_offset", "per_server", (S,))
+    lay.add("pf_lastepoch", "per_server", (S,))
+    lay.add("pf_dest", "per_server_val", (S,))
+    lay.add("log_epoch", "per_server", (S, L))
+    lay.add("log_value", "per_server", (S, L))
+    lay.add("log_len", "per_server", (S,))
+    lay.add("highWatermark", "per_server", (S,))
+    lay.add("votesGranted", "server_bitmask", (S,))
+    lay.add("endOffset", "per_server_pair", (S, S))
+    lay.add("acked", "scalar", (V,))  # in VIEW (KRaft.tla:154)
+    lay.add("msg_hi", "msg_hi", (M,))
+    lay.add("msg_lo", "msg_lo", (M,))
+    lay.add("msg_cnt", "msg_cnt", (M,))
+    lay.add("electionCtr", "aux")
+    lay.add("restartCtr", "aux")
+    return lay.finish()
+
+
+def _build_packer(p: KRaftParams) -> BitPacker:
+    tb = bits_for(p.max_epoch)
+    sb = bits_for(p.n_servers - 1)
+    nb = bits_for(p.n_servers)  # nil-valued server fields (0..S)
+    lb = bits_for(p.max_log + 1)
+    vb = bits_for(p.n_values)
+    return BitPacker(
+        [
+            ("mtype", 3),
+            ("mepoch", tb),
+            ("msource", sb),
+            ("mdest", sb),
+            ("mlastLogEpoch", tb),  # RequestVoteRequest (KRaft.tla:450-455)
+            ("mlastLogOffset", lb),
+            ("mleader", nb),  # RequestVote/Fetch responses (KRaft.tla:500)
+            ("mvoteGranted", 1),
+            ("merror", 2),
+            ("mresult", 2),  # FetchResponse only (KRaft.tla:81)
+            ("mfetchOffset", lb),  # FetchRequest (KRaft.tla:616-621)
+            ("mlastFetchedEpoch", tb),
+            ("mhwm", lb),
+            ("nentries", 1),  # <=1 entry per response (KRaft.tla:710-712)
+            ("eepoch", tb),
+            ("evalue", vb),
+            ("mdivergingEpoch", tb),  # Diverging response (KRaft.tla:671-672)
+            ("mdivergingEndOffset", lb),
+            ("cepoch", tb),  # correlation = embedded request (KRaft.tla:649);
+            ("cfetchOffset", lb),  # its source/dest are implied (swapped)
+            ("clastFetchedEpoch", tb),
+        ]
+    )
+
+
+def cached_model(params: "KRaftParams") -> "KRaftModel":
+    return _cached_model(params)
+
+
+class KRaftModel:
+    """Vectorized successor/invariant kernels for one (spec, constants) pair."""
+
+    name = "KRaft"
+    # symmetry: mleader is a nil-valued server field inside packed records
+    msg_server_fields = ("msource", "mdest")
+    msg_server_nil_fields = ("mleader",)
+
+    def __init__(self, params: KRaftParams, server_names=None, value_names=None):
+        self.p = params
+        self.layout = _build_layout(params)
+        self.packer = _build_packer(params)
+        S, V, M = params.n_servers, params.n_values, params.msg_slots
+        self.server_names = list(server_names or [f"s{i+1}" for i in range(S)])
+        self.value_names = list(value_names or [f"v{i+1}" for i in range(V)])
+
+        # Candidate table: non-receipt disjuncts in Next order
+        # (KRaft.tla:823-840), receipt disjuncts fused per slot at the end
+        # (mutually exclusive per record; rank resolved dynamically).
+        self.bindings: list[tuple[str, tuple]] = []
+        self._pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
+        for i in range(S):
+            self.bindings.append(("Restart", (i,)))
+        for i in range(S):
+            self.bindings.append(("RequestVote", (i,)))
+        for i in range(S):
+            self.bindings.append(("BecomeLeader", (i,)))
+        for i in range(S):
+            for v in range(V):
+                self.bindings.append(("ClientRequest", (i, v)))
+        for ij in self._pairs:
+            self.bindings.append(("SendFetchRequest", ij))
+        for m in range(M):
+            self.bindings.append(("HandleMessage", (m,)))
+        self.A = len(self.bindings)
+
+        self.expand = jax.jit(jax.vmap(self._expand1))
+        self.invariants = {
+            "NoIllegalState": jax.jit(self._inv_no_illegal),
+            "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
+            "NeverTwoLeadersInSameEpoch": jax.jit(self._inv_never_two_leaders),
+            "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
+            "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
+            "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
+        }
+
+    def action_label(self, rank: int, cand: int) -> str:
+        name, binding = self.bindings[cand]
+        if name == "HandleMessage":
+            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
+        return f"{name}{binding}"
+
+    # ---------------- field access helpers ----------------
+
+    def _dec(self, s):
+        g = self.layout.get
+        return {f: g(s, f) for f in self.layout.fields}
+
+    def _asm(self, d, **updates):
+        parts = []
+        for name, f in self.layout.fields.items():
+            arr = updates.get(name, d[name])
+            arr = jnp.asarray(arr, jnp.int32)
+            parts.append(arr.reshape(-1) if f.shape else arr.reshape(1))
+        return jnp.concatenate(parts)
+
+    def _pack(self, **vals):
+        hi, lo = self.packer.pack(**vals)
+        return jnp.asarray(hi, jnp.int32), jnp.asarray(lo, jnp.int32)
+
+    @staticmethod
+    def _last_epoch(d, i):
+        """LastEpoch(log[i]) — KRaft.tla:165."""
+        ll = d["log_len"][i]
+        return jnp.where(ll > 0, d["log_epoch"][i][jnp.clip(ll - 1, 0)], 0)
+
+    # ---------------- transition machine (KRaft.tla:312-392) ----------------
+    # All helpers take/return (state, epoch, leader_enc) int32 triples with
+    # leader_enc in 0..S (0 = Nil).
+
+    def _maybe_transition(self, d, i, leader_enc, epoch):
+        """MaybeTransition — KRaft.tla:351-367."""
+        st_i = d["state"][i]
+        cur = d["currentEpoch"][i]
+        led = d["leader"][i]
+        # HasConsistentLeader (KRaft.tla:316-327)
+        hcl = jnp.where(
+            leader_enc == i + 1,
+            st_i == LEADER,
+            (epoch != cur) | (leader_enc == NIL) | (led == NIL) | (led == leader_enc),
+        )
+        # TransitionToFollower (KRaft.tla:344-349)
+        tf_ill = (cur == epoch) & ((st_i == FOLLOWER) | (st_i == LEADER))
+        tf = (
+            jnp.where(tf_ill, ILLEGAL, FOLLOWER),
+            jnp.where(tf_ill, 0, epoch),
+            jnp.where(tf_ill, 0, leader_enc),
+        )
+        una = (jnp.int32(UNATTACHED), epoch, jnp.int32(NIL))
+        noop = (st_i, cur, led)
+        # CASE chain, first match wins
+        c1 = ~hcl
+        c2 = epoch > cur
+        c2_pick = jnp.where(leader_enc == NIL, 1, 2)  # 1=unattached, 2=follower
+        c3 = (leader_enc != NIL) & (led == NIL)
+        sel = jnp.where(
+            c1, 0, jnp.where(c2, c2_pick, jnp.where(c3, 2, 3))
+        )  # 0=illegal,1=unattached,2=follower,3=noop
+        out = []
+        ill = (jnp.int32(ILLEGAL), jnp.int32(0), jnp.int32(NIL))
+        for k in range(3):
+            out.append(
+                jnp.where(
+                    sel == 0,
+                    ill[k],
+                    jnp.where(sel == 1, una[k], jnp.where(sel == 2, tf[k], noop[k])),
+                )
+            )
+        return tuple(out)
+
+    def _maybe_handle_common(self, d, i, leader_enc, epoch, err):
+        """MaybeHandleCommonResponse — KRaft.tla:369-392.
+        Returns (state, epoch, leader_enc, handled)."""
+        st_i = d["state"][i]
+        cur = d["currentEpoch"][i]
+        led = d["leader"][i]
+        mt = self._maybe_transition(d, i, leader_enc, epoch)
+        c_stale = epoch < cur
+        c_trans = (epoch > cur) | (err != E_NONE)
+        c_follow = (epoch == cur) & (leader_enc != NIL) & (led == NIL)
+        sel = jnp.where(
+            c_stale, 0, jnp.where(c_trans, 1, jnp.where(c_follow, 2, 3))
+        )
+        fol = (jnp.int32(FOLLOWER), cur, leader_enc)
+        noop = (st_i, cur, led)
+        out = []
+        for k in range(3):
+            out.append(
+                jnp.where(
+                    sel == 0,
+                    noop[k],
+                    jnp.where(sel == 1, mt[k], jnp.where(sel == 2, fol[k], noop[k])),
+                )
+            )
+        handled = sel != 3
+        return out[0], out[1], out[2], handled
+
+    # ---------------- log-position math (KRaft.tla:247-310) ----------------
+
+    def _end_offset_for_epoch(self, d, i, last_fetched_epoch):
+        """EndOffsetForEpoch — KRaft.tla:285-301: (offset, epoch) of the
+        highest entry with epoch <= last_fetched_epoch; (0,0) if none."""
+        L = self.p.max_log
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        row = d["log_epoch"][i]
+        mask = (lanes < d["log_len"][i]) & (row <= last_fetched_epoch)
+        off = jnp.max(jnp.where(mask, lanes + 1, 0))
+        ep = jnp.where(off > 0, row[jnp.clip(off - 1, 0)], 0)
+        return off, ep
+
+    def _highest_common_offset(self, d, i, end_off, epoch):
+        """HighestCommonOffset — KRaft.tla:255-273: highest offset with
+        CompareEntries(offset, entry.epoch, end_off, epoch) <= 0."""
+        L = self.p.max_log
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        row = d["log_epoch"][i]
+        le = (row < epoch) | ((row == epoch) & (lanes + 1 <= end_off))
+        mask = (lanes < d["log_len"][i]) & le
+        return jnp.max(jnp.where(mask, lanes + 1, 0))
+
+    def _valid_fetch_position(self, d, i, fetch_off, last_fetched_epoch):
+        """ValidFetchPosition — KRaft.tla:305-310."""
+        off, ep = self._end_offset_for_epoch(d, i, last_fetched_epoch)
+        zero = (fetch_off == 0) & (last_fetched_epoch == 0)
+        return zero | ((fetch_off <= off) & (last_fetched_epoch == ep))
+
+    # ---------------- action kernels ----------------
+
+    def _restart(self, s, i):
+        """Restart(i) — KRaft.tla:423-432: keeps currentEpoch, votedFor,
+        log; loses leader belief, votes, endOffset, hwm, pendingFetch."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        valid = d["restartCtr"] < p.max_restarts
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(FOLLOWER),
+            leader=d["leader"].at[i].set(NIL),
+            votesGranted=d["votesGranted"].at[i].set(0),
+            endOffset=d["endOffset"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            highWatermark=d["highWatermark"].at[i].set(0),
+            pf_epoch=d["pf_epoch"].at[i].set(0),
+            pf_offset=d["pf_offset"].at[i].set(0),
+            pf_lastepoch=d["pf_lastepoch"].at[i].set(0),
+            pf_dest=d["pf_dest"].at[i].set(0),
+            restartCtr=d["restartCtr"] + 1,
+        )
+        return valid, succ, jnp.int32(K_RESTART), jnp.asarray(False)
+
+    def _request_vote(self, s, i):
+        """RequestVote(i) — KRaft.tla:439-456 (fused Timeout+RequestVote;
+        enabled from Follower, Candidate or Unattached)."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        st_i = d["state"][i]
+        valid = (d["electionCtr"] < p.max_elections) & (
+            (st_i == FOLLOWER) | (st_i == CANDIDATE) | (st_i == UNATTACHED)
+        )
+        new_epoch = d["currentEpoch"][i] + 1
+        last_ep = self._last_epoch(d, i)
+        ll_i = d["log_len"][i]
+        hi, lo, cnt = d["msg_hi"], d["msg_lo"], d["msg_cnt"]
+        ovf = jnp.asarray(False)
+        for delta in range(1, S):
+            j = jnp.mod(i + delta, S)
+            khi, klo = self._pack(
+                mtype=RVREQ,
+                mepoch=new_epoch,
+                mlastLogEpoch=last_ep,
+                mlastLogOffset=ll_i,
+                msource=i,
+                mdest=j,
+            )
+            hi, lo, cnt, existed, o = bag.bag_put(hi, lo, cnt, khi, klo)
+            valid &= ~existed  # SendMultipleOnce (KRaft.tla:199-201)
+            ovf |= o
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(CANDIDATE),
+            currentEpoch=d["currentEpoch"].at[i].set(new_epoch),
+            leader=d["leader"].at[i].set(NIL),
+            votedFor=d["votedFor"].at[i].set(i + 1),
+            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
+            pf_epoch=d["pf_epoch"].at[i].set(0),
+            pf_offset=d["pf_offset"].at[i].set(0),
+            pf_lastepoch=d["pf_lastepoch"].at[i].set(0),
+            pf_dest=d["pf_dest"].at[i].set(0),
+            electionCtr=d["electionCtr"] + 1,
+            msg_hi=hi,
+            msg_lo=lo,
+            msg_cnt=cnt,
+        )
+        return valid, succ, jnp.int32(K_REQUESTVOTE), ovf & valid
+
+    def _become_leader(self, s, i):
+        """BecomeLeader(i) — KRaft.tla:546-558."""
+        S = self.p.n_servers
+        d = self._dec(s)
+        votes = jnp.sum((d["votesGranted"][i] >> jnp.arange(S, dtype=jnp.int32)) & 1)
+        valid = (d["state"][i] == CANDIDATE) & (2 * votes > S)
+        hi, lo, cnt = d["msg_hi"], d["msg_lo"], d["msg_cnt"]
+        ovf = jnp.asarray(False)
+        for delta in range(1, S):
+            j = jnp.mod(i + delta, S)
+            khi, klo = self._pack(
+                mtype=BQREQ, mepoch=d["currentEpoch"][i], msource=i, mdest=j
+            )
+            hi, lo, cnt, existed, o = bag.bag_put(hi, lo, cnt, khi, klo)
+            valid &= ~existed  # SendMultipleOnce
+            ovf |= o
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(LEADER),
+            leader=d["leader"].at[i].set(i + 1),
+            endOffset=d["endOffset"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            msg_hi=hi,
+            msg_lo=lo,
+            msg_cnt=cnt,
+        )
+        return valid, succ, jnp.int32(K_BECOMELEADER), ovf & valid
+
+    def _client_request(self, s, i, v):
+        """ClientRequest(i, v) — KRaft.tla:594-603."""
+        L = self.p.max_log
+        d = self._dec(s)
+        valid = (d["state"][i] == LEADER) & (d["acked"][v] == ACK_NIL)
+        pos = d["log_len"][i]
+        ovf = valid & (pos >= L)
+        posc = jnp.clip(pos, 0, L - 1)
+        succ = self._asm(
+            d,
+            log_epoch=d["log_epoch"].at[i, posc].set(d["currentEpoch"][i]),
+            log_value=d["log_value"].at[i, posc].set(v + 1),
+            log_len=d["log_len"].at[i].add(1),
+            acked=d["acked"].at[v].set(ACK_FALSE),
+        )
+        return valid, succ, jnp.int32(K_CLIENTREQUEST), ovf
+
+    def _send_fetch_request(self, s, i, j):
+        """SendFetchRequest(i, j) — KRaft.tla:607-624. FetchRequest is an
+        unrestricted send (KRaft.tla:190-194); the pendingFetch[i] = Nil
+        gate provides the flow control."""
+        d = self._dec(s)
+        valid = (
+            (d["state"][i] == FOLLOWER)
+            & (d["leader"][i] == j + 1)
+            & (d["pf_epoch"][i] == 0)
+        )
+        ll_i = d["log_len"][i]
+        last_ep = self._last_epoch(d, i)
+        khi, klo = self._pack(
+            mtype=FETCHREQ,
+            mepoch=d["currentEpoch"][i],
+            mfetchOffset=ll_i,
+            mlastFetchedEpoch=last_ep,
+            msource=i,
+            mdest=j,
+        )
+        hi, lo, cnt, _existed, ovf = bag.bag_put(
+            d["msg_hi"], d["msg_lo"], d["msg_cnt"], khi, klo
+        )
+        succ = self._asm(
+            d,
+            pf_epoch=d["pf_epoch"].at[i].set(d["currentEpoch"][i]),
+            pf_offset=d["pf_offset"].at[i].set(ll_i),
+            pf_lastepoch=d["pf_lastepoch"].at[i].set(last_ep),
+            pf_dest=d["pf_dest"].at[i].set(j + 1),
+            msg_hi=hi,
+            msg_lo=lo,
+            msg_cnt=cnt,
+        )
+        return valid, succ, jnp.int32(K_SENDFETCH), ovf & valid
+
+    # -------- fused message-receipt kernel (slot m) --------
+    # The nine receipt disjuncts of Next (KRaft.tla:827-840) are mutually
+    # exclusive for a fixed record (they partition on mtype, then on
+    # error/validity/mresult), so one kernel per slot computes whichever
+    # fires; `rank` reports which for trace labels.
+
+    def _handle_message(self, s, m):
+        p, packer = self.p, self.packer
+        S, L = p.n_servers, p.max_log
+        d = self._dec(s)
+        hi, lo, cnt = d["msg_hi"], d["msg_lo"], d["msg_cnt"]
+        khi, klo, kcnt = hi[m], lo[m], cnt[m]
+        occupied = khi != EMPTY
+        u = partial(packer.unpack, khi, klo)
+        mtype, mepoch = u("mtype"), u("mepoch")
+        src, dst = u("msource"), u("mdest")
+        cur = d["currentEpoch"][dst]
+        st_dst = d["state"][dst]
+        led_dst = d["leader"][dst]
+        recv = occupied & (kcnt > 0)  # ReceivableMessage (KRaft.tla:230-235)
+        equal_epoch = mepoch == cur
+
+        def reply(resp_hi, resp_lo):
+            """Reply — KRaft.tla:220-227; caller enforces the FetchResponse
+            no-duplicate rule via the returned `existed`."""
+            c2 = bag.bag_discard_at(cnt, m)
+            return bag.bag_put(hi, lo, c2, resp_hi, resp_lo)
+
+        def clear_pf(upd):
+            upd["pf_epoch"] = d["pf_epoch"].at[dst].set(0)
+            upd["pf_offset"] = d["pf_offset"].at[dst].set(0)
+            upd["pf_lastepoch"] = d["pf_lastepoch"].at[dst].set(0)
+            upd["pf_dest"] = d["pf_dest"].at[dst].set(0)
+            return upd
+
+        # --- HandleRequestVoteRequest (KRaft.tla:464-513)
+        b_rvreq = recv & (mtype == RVREQ)
+        rv_err = mepoch < cur  # FencedLeaderEpoch
+        # state0 (KRaft.tla:472-474)
+        s0_st = jnp.where(mepoch > cur, UNATTACHED, st_dst)
+        s0_ep = jnp.where(mepoch > cur, mepoch, cur)
+        s0_ld = jnp.where(mepoch > cur, NIL, led_dst)
+        last_ep = self._last_epoch(d, dst)
+        ll_dst = d["log_len"][dst]
+        # logOk: CompareEntries(mllo, mlle, Len, LastEpoch) >= 0 (:475-478)
+        log_ok = (u("mlastLogEpoch") > last_ep) | (
+            (u("mlastLogEpoch") == last_ep) & (u("mlastLogOffset") >= ll_dst)
+        )
+        grant = (
+            (s0_st == UNATTACHED) | ((s0_st == VOTED) & (d["votedFor"][dst] == src + 1))
+        ) & log_ok
+        # finalState: TransitionToVoted when grant from Unattached (:483-485);
+        # the Unattached precondition makes the illegal arm unreachable.
+        take_voted = grant & (s0_st == UNATTACHED)
+        f_st = jnp.where(take_voted, VOTED, s0_st)
+        f_ep = jnp.where(take_voted, mepoch, s0_ep)
+        f_ld = jnp.where(take_voted, NIL, s0_ld)
+        # error path replies with (cur, leader[i]); normal with (mepoch, final)
+        r_ep = jnp.where(rv_err, cur, mepoch)
+        r_ld = jnp.where(rv_err, led_dst, f_ld)
+        r_grant = jnp.where(rv_err, 0, grant.astype(jnp.int32))
+        r_err = jnp.where(rv_err, E_FENCED, E_NONE)
+        rhi, rlo = self._pack(
+            mtype=RVRESP,
+            mepoch=r_ep,
+            mleader=r_ld,
+            mvoteGranted=r_grant,
+            merror=r_err,
+            msource=dst,
+            mdest=src,
+        )
+        hi1, lo1, cnt1, _ex1, ovf1 = reply(rhi, rlo)
+        upd1 = dict(msg_hi=hi1, msg_lo=lo1, msg_cnt=cnt1)
+        no_err = ~rv_err
+        upd1["state"] = jnp.where(no_err, d["state"].at[dst].set(f_st), d["state"])
+        upd1["currentEpoch"] = jnp.where(
+            no_err, d["currentEpoch"].at[dst].set(f_ep), d["currentEpoch"]
+        )
+        upd1["leader"] = jnp.where(no_err, d["leader"].at[dst].set(f_ld), d["leader"])
+        upd1["votedFor"] = jnp.where(
+            no_err & grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]
+        )
+        # IF state # state' THEN reset pendingFetch (KRaft.tla:495-497)
+        pf_reset = no_err & (f_st != st_dst)
+        for pf in ("pf_epoch", "pf_offset", "pf_lastepoch", "pf_dest"):
+            upd1[pf] = jnp.where(pf_reset, d[pf].at[dst].set(0), d[pf])
+        s_rvreq = self._asm(d, **upd1)
+
+        # --- HandleRequestVoteResponse (KRaft.tla:519-541)
+        mh_st, mh_ep, mh_ld, handled = self._maybe_handle_common(
+            d, dst, u("mleader"), mepoch, u("merror")
+        )
+        b_rvresp = recv & (mtype == RVRESP) & (handled | (st_dst == CANDIDATE))
+        cnt_disc = bag.bag_discard_at(cnt, m)
+        granted_bit = (u("mvoteGranted") > 0) & ~handled
+        upd2 = dict(
+            state=jnp.where(handled, d["state"].at[dst].set(mh_st), d["state"]),
+            currentEpoch=jnp.where(
+                handled, d["currentEpoch"].at[dst].set(mh_ep), d["currentEpoch"]
+            ),
+            leader=jnp.where(handled, d["leader"].at[dst].set(mh_ld), d["leader"]),
+            votesGranted=jnp.where(
+                granted_bit,
+                d["votesGranted"].at[dst].set(d["votesGranted"][dst] | (jnp.int32(1) << src)),
+                d["votesGranted"],
+            ),
+            msg_cnt=cnt_disc,
+        )
+        s_rvresp = self._asm(d, **upd2)
+
+        # --- HandleBeginQuorumRequest (KRaft.tla:563-590)
+        b_bqreq = recv & (mtype == BQREQ)
+        bq_err = mepoch < cur
+        bt_st, bt_ep, bt_ld = self._maybe_transition(d, dst, src + 1, mepoch)
+        bq_rep = jnp.where(bq_err, cur, mepoch)
+        bq_rerr = jnp.where(bq_err, E_FENCED, E_NONE)
+        bhi, blo = self._pack(
+            mtype=BQRESP, mepoch=bq_rep, msource=dst, mdest=src, merror=bq_rerr
+        )
+        hi3, lo3, cnt3, _ex3, ovf3 = reply(bhi, blo)
+        upd3 = dict(msg_hi=hi3, msg_lo=lo3, msg_cnt=cnt3)
+        ok3 = ~bq_err
+        upd3["state"] = jnp.where(ok3, d["state"].at[dst].set(bt_st), d["state"])
+        upd3["currentEpoch"] = jnp.where(
+            ok3, d["currentEpoch"].at[dst].set(bt_ep), d["currentEpoch"]
+        )
+        upd3["leader"] = jnp.where(ok3, d["leader"].at[dst].set(bt_ld), d["leader"])
+        for pf in ("pf_epoch", "pf_offset", "pf_lastepoch", "pf_dest"):
+            upd3[pf] = jnp.where(ok3, d[pf].at[dst].set(0), d[pf])
+        s_bqreq = self._asm(d, **upd3)
+
+        # --- FetchRequest branches (KRaft.tla:631-736)
+        is_fetchreq = recv & (mtype == FETCHREQ)
+        is_leader = st_dst == LEADER
+        ferr = jnp.where(
+            ~is_leader,
+            E_NOTLEADER,
+            jnp.where(
+                mepoch < cur, E_FENCED, jnp.where(mepoch > cur, E_UNKNOWN, E_NONE)
+            ),
+        )
+        foff = u("mfetchOffset")
+        flep = u("mlastFetchedEpoch")
+        valid_pos = self._valid_fetch_position(d, dst, foff, flep)
+        eo_off, eo_ep = self._end_offset_for_epoch(d, dst, flep)
+
+        # RejectFetchRequest (KRaft.tla:631-651)
+        b_reject = is_fetchreq & (ferr != E_NONE)
+        rjhi, rjlo = self._pack(
+            mtype=FETCHRESP,
+            mresult=R_NOTOK,
+            merror=ferr,
+            mleader=led_dst,
+            mepoch=cur,
+            mhwm=d["highWatermark"][dst],
+            msource=dst,
+            mdest=src,
+            cepoch=mepoch,
+            cfetchOffset=foff,
+            clastFetchedEpoch=flep,
+        )
+        hi4, lo4, cnt4, ex4, ovf4 = reply(rjhi, rjlo)
+        b_reject &= ~ex4  # FetchResponse no-duplicate rule (KRaft.tla:224-227)
+        s_reject = self._asm(d, msg_hi=hi4, msg_lo=lo4, msg_cnt=cnt4)
+
+        # DivergingFetchRequest (KRaft.tla:658-679)
+        b_div = is_fetchreq & equal_epoch & is_leader & ~valid_pos
+        dvhi, dvlo = self._pack(
+            mtype=FETCHRESP,
+            mepoch=cur,
+            mresult=R_DIVERGING,
+            merror=E_NONE,
+            mdivergingEpoch=eo_ep,
+            mdivergingEndOffset=eo_off,
+            mleader=led_dst,
+            mhwm=d["highWatermark"][dst],
+            msource=dst,
+            mdest=src,
+            cepoch=mepoch,
+            cfetchOffset=foff,
+            clastFetchedEpoch=flep,
+        )
+        hi5, lo5, cnt5, ex5, ovf5 = reply(dvhi, dvlo)
+        b_div &= ~ex5
+        s_div = self._asm(d, msg_hi=hi5, msg_lo=lo5, msg_cnt=cnt5)
+
+        # AcceptFetchRequest (KRaft.tla:703-736)
+        b_accept = is_fetchreq & equal_epoch & is_leader & valid_pos
+        offset = foff + 1
+        have_entry = offset <= d["log_len"][dst]
+        epos = jnp.clip(offset - 1, 0, L - 1)
+        ent_ep = jnp.where(have_entry, d["log_epoch"][dst][epos], 0)
+        ent_v = jnp.where(have_entry, d["log_value"][dst][epos], 0)
+        new_end = d["endOffset"][dst].at[src].set(foff)
+        # NewHighwaterMark (KRaft.tla:689-701)
+        idxs = jnp.arange(1, L + 1, dtype=jnp.int32)
+        self_in = jnp.arange(S, dtype=jnp.int32)[None, :] == dst
+        agree = self_in | (new_end[None, :] >= idxs[:, None])
+        quorum_ok = 2 * jnp.sum(agree, axis=1) > S
+        in_log = idxs <= d["log_len"][dst]
+        max_agree = jnp.max(jnp.where(quorum_ok & in_log, idxs, 0))
+        ep_at = d["log_epoch"][dst][jnp.clip(max_agree - 1, 0)]
+        hwm_old = d["highWatermark"][dst]
+        new_hwm = jnp.where(
+            (max_agree > 0) & (ep_at == cur), max_agree, hwm_old
+        )
+        # acked: FALSE -> committed in (hwm_old, new_hwm] (KRaft.tla:721-724)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_range = (lanes + 1 > hwm_old) & (lanes + 1 <= new_hwm)
+        vals_row = d["log_value"][dst]
+        committed = jnp.any(
+            in_range[None, :]
+            & (vals_row[None, :] == jnp.arange(1, p.n_values + 1, dtype=jnp.int32)[:, None]),
+            axis=1,
+        )
+        acked = jnp.where(
+            (d["acked"] == ACK_FALSE) & committed, ACK_TRUE, d["acked"]
+        )
+        achi, aclo = self._pack(
+            mtype=FETCHRESP,
+            mepoch=cur,
+            mleader=led_dst,
+            mresult=R_OK,
+            merror=E_NONE,
+            nentries=have_entry.astype(jnp.int32),
+            eepoch=ent_ep,
+            evalue=ent_v,
+            mhwm=jnp.minimum(new_hwm, offset),
+            msource=dst,
+            mdest=src,
+            cepoch=mepoch,
+            cfetchOffset=foff,
+            clastFetchedEpoch=flep,
+        )
+        hi6, lo6, cnt6, ex6, ovf6 = reply(achi, aclo)
+        b_accept &= ~ex6
+        s_accept = self._asm(
+            d,
+            endOffset=d["endOffset"].at[dst].set(new_end),
+            highWatermark=d["highWatermark"].at[dst].set(new_hwm),
+            acked=acked,
+            msg_hi=hi6,
+            msg_lo=lo6,
+            msg_cnt=cnt6,
+        )
+
+        # --- FetchResponse branches (KRaft.tla:742-801)
+        is_fresp = recv & (mtype == FETCHRESP)
+        # correlation match: pendingFetch[dst] = m.correlation (:749); the
+        # request's msource is dst (implied) and mdest is the responder src.
+        corr = (
+            (d["pf_epoch"][dst] > 0)
+            & (d["pf_epoch"][dst] == u("cepoch"))
+            & (d["pf_offset"][dst] == u("cfetchOffset"))
+            & (d["pf_lastepoch"][dst] == u("clastFetchedEpoch"))
+            & (d["pf_dest"][dst] == src + 1)
+        )
+        mres = u("mresult")
+
+        # HandleSuccessFetchResponse (KRaft.tla:742-757)
+        b_ok = is_fresp & ~handled & corr & (mres == R_OK)
+        app = u("nentries") > 0
+        ll_dst2 = d["log_len"][dst]
+        apos = jnp.clip(ll_dst2, 0, L - 1)
+        ok_ovf = b_ok & app & (ll_dst2 >= L)
+        upd7 = dict(
+            highWatermark=d["highWatermark"].at[dst].set(u("mhwm")),
+            log_epoch=jnp.where(
+                app, d["log_epoch"].at[dst, apos].set(u("eepoch")), d["log_epoch"]
+            ),
+            log_value=jnp.where(
+                app, d["log_value"].at[dst, apos].set(u("evalue")), d["log_value"]
+            ),
+            log_len=jnp.where(app, d["log_len"].at[dst].add(1), d["log_len"]),
+            msg_cnt=cnt_disc,
+        )
+        s_ok = self._asm(d, **clear_pf(upd7))
+
+        # HandleDivergingFetchResponse (KRaft.tla:766-780)
+        b_divr = is_fresp & ~handled & corr & (mres == R_DIVERGING)
+        hco = self._highest_common_offset(
+            d, dst, u("mdivergingEndOffset"), u("mdivergingEpoch")
+        )
+        keep = jnp.arange(L, dtype=jnp.int32) < hco
+        upd8 = dict(
+            log_epoch=d["log_epoch"].at[dst].set(
+                jnp.where(keep, d["log_epoch"][dst], 0)
+            ),
+            log_value=d["log_value"].at[dst].set(
+                jnp.where(keep, d["log_value"][dst], 0)
+            ),
+            log_len=d["log_len"].at[dst].set(hco),
+            msg_cnt=cnt_disc,
+        )
+        s_divr = self._asm(d, **clear_pf(upd8))
+
+        # HandleErrorFetchResponse (KRaft.tla:786-801)
+        b_err = is_fresp & handled & corr
+        upd9 = dict(
+            state=d["state"].at[dst].set(mh_st),
+            currentEpoch=d["currentEpoch"].at[dst].set(mh_ep),
+            leader=d["leader"].at[dst].set(mh_ld),
+            msg_cnt=cnt_disc,
+        )
+        s_err = self._asm(d, **clear_pf(upd9))
+
+        branches = [
+            (b_rvreq, s_rvreq, K_HANDLE_RVREQ, ovf1),
+            (b_rvresp, s_rvresp, K_HANDLE_RVRESP, jnp.asarray(False)),
+            (b_reject, s_reject, K_REJECT_FETCH, ovf4),
+            (b_div, s_div, K_DIVERGING_FETCH, ovf5),
+            (b_accept, s_accept, K_ACCEPT_FETCH, ovf6),
+            (b_bqreq, s_bqreq, K_HANDLE_BQREQ, ovf3),
+            (b_ok, s_ok, K_HANDLE_FETCH_OK, ok_ovf),
+            (b_divr, s_divr, K_HANDLE_FETCH_DIV, jnp.asarray(False)),
+            (b_err, s_err, K_HANDLE_FETCH_ERR, jnp.asarray(False)),
+        ]
+        valid = jnp.asarray(False)
+        succ = s
+        rank = jnp.int32(-1)
+        ovf = jnp.asarray(False)
+        for b, sb, rk, ob in branches:
+            valid = valid | b
+            succ = jnp.where(b, sb, succ)
+            rank = jnp.where(b, jnp.int32(rk), rank)
+            ovf = ovf | (b & ob)
+        return valid, succ, rank, ovf
+
+    # ---------------- full expansion ----------------
+
+    def _expand1(self, s):
+        """All successor candidates of one state.
+
+        Returns (succs [A, W], valid [A], rank [A], ovf [A])."""
+        p = self.p
+        S, V, M = p.n_servers, p.n_values, p.msg_slots
+        iota_s = jnp.arange(S, dtype=jnp.int32)
+        pr_i = jnp.asarray([ij[0] for ij in self._pairs], jnp.int32)
+        pr_j = jnp.asarray([ij[1] for ij in self._pairs], jnp.int32)
+        outs = []
+        outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
+        cr_i = jnp.repeat(iota_s, V)
+        cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
+        outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
+        outs.append(
+            jax.vmap(lambda i, j: self._send_fetch_request(s, i, j))(pr_i, pr_j)
+        )
+        outs.append(
+            jax.vmap(lambda m: self._handle_message(s, m))(jnp.arange(M, dtype=jnp.int32))
+        )
+        valid = jnp.concatenate([o[0] for o in outs])
+        succs = jnp.concatenate([o[1] for o in outs])
+        rank = jnp.concatenate([o[2] for o in outs])
+        ovf = jnp.concatenate([o[3] for o in outs])
+        return succs, valid, rank, ovf
+
+    # ---------------- initial states ----------------
+
+    def init_states(self) -> np.ndarray:
+        """Init — KRaft.tla:397-415. A single state; all Unattached."""
+        vec = self.layout.zeros((1,))
+        lay = self.layout
+        vec[0, lay.sl("currentEpoch")] = 1
+        vec[0, lay.sl("state")] = UNATTACHED
+        vec[0, lay.sl("msg_hi")] = int(EMPTY)
+        vec[0, lay.sl("msg_lo")] = int(EMPTY)
+        vec[0, lay.sl("acked")] = ACK_NIL
+        return vec
+
+    # ---------------- invariants ----------------
+
+    def _inv_no_illegal(self, states):
+        """NoIllegalState — KRaft.tla:887-889."""
+        st = self.layout.get(states, "state")
+        return jnp.all(st != ILLEGAL, axis=1)
+
+    def _inv_no_log_divergence(self, states):
+        """NoLogDivergence — KRaft.tla:894-907 (common prefix up to the
+        pairwise-minimum highWatermark)."""
+        lay, L = self.layout, self.p.max_log
+        hwm = lay.get(states, "highWatermark")
+        lt = lay.get(states, "log_epoch")
+        lv = lay.get(states, "log_value")
+        mh = jnp.minimum(hwm[:, :, None], hwm[:, None, :])
+        lanes = jnp.arange(1, L + 1, dtype=jnp.int32)
+        in_common = lanes[None, None, None, :] <= mh[..., None]
+        eq = (lt[:, :, None, :] == lt[:, None, :, :]) & (
+            lv[:, :, None, :] == lv[:, None, :, :]
+        )
+        return jnp.all(~in_common | eq, axis=(1, 2, 3))
+
+    def _inv_never_two_leaders(self, states):
+        """NeverTwoLeadersInSameEpoch — KRaft.tla:916-921."""
+        lay = self.layout
+        led = lay.get(states, "leader")
+        ep = lay.get(states, "currentEpoch")
+        both = (led[:, :, None] != NIL) & (led[:, None, :] != NIL)
+        conflict = (
+            both
+            & (led[:, :, None] != led[:, None, :])
+            & (ep[:, :, None] == ep[:, None, :])
+        )
+        return ~jnp.any(conflict, axis=(1, 2))
+
+    def _inv_leader_has_acked(self, states):
+        """LeaderHasAllAckedValues — KRaft.tla:925-941."""
+        lay, V = self.layout, self.p.n_values
+        ep = lay.get(states, "currentEpoch")
+        st = lay.get(states, "state")
+        lv = lay.get(states, "log_value")
+        acked = lay.get(states, "acked")
+        not_stale = jnp.all(ep[:, :, None] >= ep[:, None, :], axis=2)
+        is_lead = (st == LEADER) & not_stale
+        vals = jnp.arange(1, V + 1, dtype=jnp.int32)
+        has_v = jnp.any(lv[:, :, None, :] == vals[None, None, :, None], axis=3)
+        bad = jnp.any(
+            (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v,
+            axis=(1, 2),
+        )
+        return ~bad
+
+    def _inv_committed_majority(self, states):
+        """CommittedEntriesReachMajority — KRaft.tla:946-957."""
+        lay, S, L = self.layout, self.p.n_servers, self.p.max_log
+        st = lay.get(states, "state")
+        hwm = lay.get(states, "highWatermark")
+        ll = lay.get(states, "log_len")
+        lt = lay.get(states, "log_epoch")
+        lv = lay.get(states, "log_value")
+        lead = (st == LEADER) & (hwm > 0)
+        pos = jnp.clip(hwm - 1, 0, L - 1)
+        lt_i = jnp.take_along_axis(lt, pos[:, :, None], axis=2)[:, :, 0]
+        lv_i = jnp.take_along_axis(lv, pos[:, :, None], axis=2)[:, :, 0]
+        posj = jnp.broadcast_to(pos[:, :, None], pos.shape + (S,))
+        lt_j = jnp.take_along_axis(
+            jnp.broadcast_to(lt[:, None, :, :], lt.shape[:1] + (S,) + lt.shape[1:]),
+            posj[..., None],
+            axis=3,
+        )[..., 0]
+        lv_j = jnp.take_along_axis(
+            jnp.broadcast_to(lv[:, None, :, :], lv.shape[:1] + (S,) + lv.shape[1:]),
+            posj[..., None],
+            axis=3,
+        )[..., 0]
+        match = (
+            (ll[:, None, :] >= hwm[:, :, None])
+            & (lt_j == lt_i[..., None])
+            & (lv_j == lv_i[..., None])
+        )
+        enough = jnp.sum(match, axis=2) >= (S // 2 + 1)
+        ok_exists = jnp.any(lead & enough, axis=1)
+        return ~jnp.any(lead, axis=1) | ok_exists
+
+    # ---------------- host-side decode/encode ----------------
+
+    def decode(self, vec: np.ndarray) -> dict:
+        """Decode one packed state into the canonical python form shared
+        with oracle/kraft_oracle.py."""
+        lay, p = self.layout, self.p
+        g = lambda n: np.asarray(vec[lay.sl(n)])
+        S, L = p.n_servers, p.max_log
+        lt = g("log_epoch").reshape(S, L)
+        lv = g("log_value").reshape(S, L)
+        ll = g("log_len")
+        log = tuple(
+            tuple((int(lt[i, k]), int(lv[i, k]) - 1) for k in range(int(ll[i])))
+            for i in range(S)
+        )
+        vg = g("votesGranted")
+        votes = tuple(
+            frozenset(j for j in range(S) if (int(vg[i]) >> j) & 1) for i in range(S)
+        )
+        pf_ep, pf_off = g("pf_epoch"), g("pf_offset")
+        pf_le, pf_d = g("pf_lastepoch"), g("pf_dest")
+        pending = []
+        for i in range(S):
+            if int(pf_ep[i]) == 0:
+                pending.append(None)
+            else:
+                pending.append(
+                    tuple(
+                        sorted(
+                            {
+                                "mtype": "FetchRequest",
+                                "mepoch": int(pf_ep[i]),
+                                "mfetchOffset": int(pf_off[i]),
+                                "mlastFetchedEpoch": int(pf_le[i]),
+                                "msource": i,
+                                "mdest": int(pf_d[i]) - 1,
+                            }.items()
+                        )
+                    )
+                )
+        msgs = {}
+        hi, lo, cnt = g("msg_hi"), g("msg_lo"), g("msg_cnt")
+        for k in range(p.msg_slots):
+            if int(hi[k]) == int(EMPTY):
+                continue
+            msgs[self.decode_msg(int(hi[k]), int(lo[k]))] = int(cnt[k])
+        return {
+            "currentEpoch": tuple(int(x) for x in g("currentEpoch")),
+            "state": tuple(int(x) for x in g("state")),
+            "votedFor": tuple(int(x) - 1 if x > 0 else None for x in g("votedFor")),
+            "leader": tuple(int(x) - 1 if x > 0 else None for x in g("leader")),
+            "pendingFetch": tuple(pending),
+            "votesGranted": votes,
+            "endOffset": tuple(
+                tuple(int(x) for x in row) for row in g("endOffset").reshape(S, S)
+            ),
+            "log": log,
+            "highWatermark": tuple(int(x) for x in g("highWatermark")),
+            "messages": frozenset(msgs.items()),
+            "acked": tuple(
+                {ACK_NIL: None, ACK_FALSE: False, ACK_TRUE: True}[int(x)]
+                for x in g("acked")
+            ),
+            "electionCtr": int(vec[lay.fields["electionCtr"].offset]),
+            "restartCtr": int(vec[lay.fields["restartCtr"].offset]),
+        }
+
+    def decode_msg(self, hi: int, lo: int) -> tuple:
+        u = self.packer.unpack_all(hi, lo)
+        mtype = int(u["mtype"])
+        rec = {
+            "mtype": MTYPE_NAMES[mtype],
+            "mepoch": int(u["mepoch"]),
+            "msource": int(u["msource"]),
+            "mdest": int(u["mdest"]),
+        }
+        if mtype == RVREQ:
+            rec["mlastLogEpoch"] = int(u["mlastLogEpoch"])
+            rec["mlastLogOffset"] = int(u["mlastLogOffset"])
+        elif mtype == RVRESP:
+            rec["mleader"] = int(u["mleader"]) - 1 if u["mleader"] else None
+            rec["mvoteGranted"] = bool(u["mvoteGranted"])
+            rec["merror"] = ERROR_NAMES[int(u["merror"])]
+        elif mtype == BQRESP:
+            rec["merror"] = ERROR_NAMES[int(u["merror"])]
+        elif mtype == FETCHREQ:
+            rec["mfetchOffset"] = int(u["mfetchOffset"])
+            rec["mlastFetchedEpoch"] = int(u["mlastFetchedEpoch"])
+        elif mtype == FETCHRESP:
+            res = int(u["mresult"])
+            rec["mresult"] = RESULT_NAMES[res]
+            rec["merror"] = ERROR_NAMES[int(u["merror"])]
+            rec["mleader"] = int(u["mleader"]) - 1 if u["mleader"] else None
+            rec["mhwm"] = int(u["mhwm"])
+            if res == R_OK:
+                rec["mentries"] = (
+                    ((int(u["eepoch"]), int(u["evalue"]) - 1),)
+                    if u["nentries"]
+                    else ()
+                )
+            if res == R_DIVERGING:
+                rec["mdivergingEpoch"] = int(u["mdivergingEpoch"])
+                rec["mdivergingEndOffset"] = int(u["mdivergingEndOffset"])
+            rec["correlation"] = tuple(
+                sorted(
+                    {
+                        "mtype": "FetchRequest",
+                        "mepoch": int(u["cepoch"]),
+                        "mfetchOffset": int(u["cfetchOffset"]),
+                        "mlastFetchedEpoch": int(u["clastFetchedEpoch"]),
+                        "msource": int(u["mdest"]),
+                        "mdest": int(u["msource"]),
+                    }.items()
+                )
+            )
+        return tuple(sorted(rec.items()))
+
+    def encode_msg(self, rec: tuple) -> tuple[int, int]:
+        d = dict(rec)
+        inv_err = {v: k for k, v in ERROR_NAMES.items()}
+        inv_res = {v: k for k, v in RESULT_NAMES.items()}
+        mtype = {v: k for k, v in MTYPE_NAMES.items()}[d["mtype"]]
+        kw = dict(
+            mtype=mtype, mepoch=d["mepoch"], msource=d["msource"], mdest=d["mdest"]
+        )
+        if mtype == RVREQ:
+            kw.update(
+                mlastLogEpoch=d["mlastLogEpoch"], mlastLogOffset=d["mlastLogOffset"]
+            )
+        elif mtype == RVRESP:
+            kw.update(
+                mleader=0 if d["mleader"] is None else d["mleader"] + 1,
+                mvoteGranted=int(d["mvoteGranted"]),
+                merror=inv_err[d["merror"]],
+            )
+        elif mtype == BQRESP:
+            kw.update(merror=inv_err[d["merror"]])
+        elif mtype == FETCHREQ:
+            kw.update(
+                mfetchOffset=d["mfetchOffset"],
+                mlastFetchedEpoch=d["mlastFetchedEpoch"],
+            )
+        elif mtype == FETCHRESP:
+            corr = dict(d["correlation"])
+            kw.update(
+                mresult=inv_res[d["mresult"]],
+                merror=inv_err[d["merror"]],
+                mleader=0 if d["mleader"] is None else d["mleader"] + 1,
+                mhwm=d["mhwm"],
+                cepoch=corr["mepoch"],
+                cfetchOffset=corr["mfetchOffset"],
+                clastFetchedEpoch=corr["mlastFetchedEpoch"],
+            )
+            if d["mresult"] == "Ok":
+                ent = d["mentries"]
+                kw.update(
+                    nentries=len(ent),
+                    eepoch=ent[0][0] if ent else 0,
+                    evalue=ent[0][1] + 1 if ent else 0,
+                )
+            if d["mresult"] == "Diverging":
+                kw.update(
+                    mdivergingEpoch=d["mdivergingEpoch"],
+                    mdivergingEndOffset=d["mdivergingEndOffset"],
+                )
+        return self.packer.pack(**kw)
+
+    def encode(self, st: dict) -> np.ndarray:
+        lay, p = self.layout, self.p
+        S, L = p.n_servers, p.max_log
+        vec = lay.zeros(())
+        vec[lay.sl("currentEpoch")] = st["currentEpoch"]
+        vec[lay.sl("state")] = st["state"]
+        vec[lay.sl("votedFor")] = [0 if v is None else v + 1 for v in st["votedFor"]]
+        vec[lay.sl("leader")] = [0 if v is None else v + 1 for v in st["leader"]]
+        pf_ep = [0] * S
+        pf_off = [0] * S
+        pf_le = [0] * S
+        pf_d = [0] * S
+        for i, pf in enumerate(st["pendingFetch"]):
+            if pf is None:
+                continue
+            c = dict(pf)
+            pf_ep[i] = c["mepoch"]
+            pf_off[i] = c["mfetchOffset"]
+            pf_le[i] = c["mlastFetchedEpoch"]
+            pf_d[i] = c["mdest"] + 1
+        vec[lay.sl("pf_epoch")] = pf_ep
+        vec[lay.sl("pf_offset")] = pf_off
+        vec[lay.sl("pf_lastepoch")] = pf_le
+        vec[lay.sl("pf_dest")] = pf_d
+        lt = np.zeros((S, L), np.int32)
+        lv = np.zeros((S, L), np.int32)
+        for i, lg in enumerate(st["log"]):
+            for k, (t, v) in enumerate(lg):
+                lt[i, k] = t
+                lv[i, k] = v + 1
+        vec[lay.sl("log_epoch")] = lt.reshape(-1)
+        vec[lay.sl("log_value")] = lv.reshape(-1)
+        vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
+        vec[lay.sl("highWatermark")] = st["highWatermark"]
+        vec[lay.sl("votesGranted")] = [
+            sum(1 << j for j in vs) for vs in st["votesGranted"]
+        ]
+        vec[lay.sl("endOffset")] = np.asarray(st["endOffset"]).reshape(-1)
+        vec[lay.sl("acked")] = [
+            {None: ACK_NIL, False: ACK_FALSE, True: ACK_TRUE}[a] for a in st["acked"]
+        ]
+        keys = sorted((self.encode_msg(rec), cnt) for rec, cnt in st["messages"])
+        if len(keys) > p.msg_slots:
+            raise OverflowError("message bag exceeds msg_slots")
+        hi = np.full(p.msg_slots, int(EMPTY), np.int32)
+        lo = np.full(p.msg_slots, int(EMPTY), np.int32)
+        cn = np.zeros(p.msg_slots, np.int32)
+        for k, ((h, l), c) in enumerate(keys):
+            hi[k], lo[k], cn[k] = h, l, c
+        vec[lay.sl("msg_hi")] = hi
+        vec[lay.sl("msg_lo")] = lo
+        vec[lay.sl("msg_cnt")] = cn
+        vec[lay.fields["electionCtr"].offset] = st["electionCtr"]
+        vec[lay.fields["restartCtr"].offset] = st["restartCtr"]
+        return vec
+
+
+@lru_cache(maxsize=None)
+def _cached_model(params: KRaftParams) -> "KRaftModel":
+    return KRaftModel(params)

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..utils.cfg import Cfg, CfgError
+from .kraft import KRaftModel, KRaftParams
 from .pull_raft import PullRaftModel, PullRaftParams
 from .raft import RaftModel, RaftParams
 
@@ -56,7 +57,7 @@ def build_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots or 48,
+        msg_slots=msg_slots if msg_slots is not None else 48,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     _check_invariants(cfg, model)
@@ -81,7 +82,7 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots or 48,
+        msg_slots=msg_slots if msg_slots is not None else 48,
         election_quorum=_require_int(cfg, "ElectionQuorumSize"),
         replication_quorum=_require_int(cfg, "ReplicationQuorumSize"),
         strict_send_once=True,
@@ -113,7 +114,7 @@ def build_raft_fsync(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         n_values=len(values),
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
-        msg_slots=msg_slots or 48,
+        msg_slots=msg_slots if msg_slots is not None else 48,
         strict_send_once=True,
         has_pending_response=False,
         trunc_term_mismatch=True,
@@ -144,7 +145,7 @@ def _build_pull(cfg: Cfg, msg_slots: int | None, variant2: bool) -> CheckSetup:
         max_restarts=_require_int(cfg, "MaxRestarts"),
         # pull specs need extra bag headroom: every message type is
         # send-once, so count-0 records pile up across a behavior
-        msg_slots=msg_slots or 64,
+        msg_slots=msg_slots if msg_slots is not None else 64,
         variant2=variant2,
     )
     model = PullRaftModel(params, server_names=servers, value_names=values)
@@ -170,12 +171,39 @@ def build_pull_raft_v2(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     return _build_pull(cfg, msg_slots, variant2=True)
 
 
+def build_kraft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """pull-raft/KRaft.tla + KRaft.cfg: Kafka KRaft (KIP-595) with five
+    server states + IllegalState, fetch-based replication with correlation,
+    error codes, and the BeginQuorumRequest leadership notify."""
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = KRaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        # fetch responses carry full correlation records, so distinct-record
+        # counts run higher than the push-based variants
+        msg_slots=msg_slots if msg_slots is not None else 80,
+    )
+    model = KRaftModel(params, server_names=servers, value_names=values)
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
 BUILDERS = {
     "Raft": build_raft,
     "FlexibleRaft": build_flexible_raft,
     "RaftFsync": build_raft_fsync,
     "PullRaft": build_pull_raft,
     "PullRaftVariant2": build_pull_raft_v2,
+    "KRaft": build_kraft,
 }
 
 
@@ -189,6 +217,10 @@ def oracle_for_setup(setup: CheckSetup):
             p.n_servers, p.n_values, p.max_elections, p.max_restarts,
             variant2=p.variant2,
         )
+    if isinstance(p, KRaftParams):
+        from ..oracle.kraft_oracle import KRaftOracle
+
+        return KRaftOracle(p.n_servers, p.n_values, p.max_elections, p.max_restarts)
     from ..oracle.raft_oracle import oracle_for
 
     return oracle_for(p)

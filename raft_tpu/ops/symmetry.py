@@ -36,11 +36,26 @@ from ..models.base import Layout
 
 
 class Canonicalizer:
+    @classmethod
+    def for_model(cls, model, symmetry: bool = True) -> "Canonicalizer":
+        """Build from a model's declared message-field symmetry contract
+        (keeps the model -> canonicalization plumbing in one place)."""
+        return cls(
+            model.layout,
+            model.packer,
+            msg_server_fields=getattr(
+                model, "msg_server_fields", ("msource", "mdest")
+            ),
+            msg_server_nil_fields=getattr(model, "msg_server_nil_fields", ()),
+            symmetry=symmetry,
+        )
+
     def __init__(
         self,
         layout: Layout,
         packer: BitPacker,
         msg_server_fields: tuple[str, ...] = ("msource", "mdest"),
+        msg_server_nil_fields: tuple[str, ...] = (),
         symmetry: bool = True,
     ):
         S = layout.n_servers
@@ -49,6 +64,9 @@ class Canonicalizer:
         self.layout = layout
         self.packer = packer
         self.msg_server_fields = msg_server_fields
+        # Nil-valued server fields inside packed records (0 = Nil, i+1 = i),
+        # e.g. KRaft's mleader (KRaft.tla:500,644): 0 stays, v -> sigma(v-1)+1.
+        self.msg_server_nil_fields = msg_server_nil_fields
 
         if symmetry:
             perms = np.array(list(itertools.permutations(range(S))), dtype=np.int32)
@@ -115,6 +133,10 @@ class Canonicalizer:
             for fname in self.msg_server_fields:
                 val = self.packer.unpack(nhi, nlo, fname)
                 nhi, nlo = self.packer.replace(nhi, nlo, fname, sigma[jnp.clip(val, 0, S - 1)])
+            for fname in self.msg_server_nil_fields:
+                val = self.packer.unpack(nhi, nlo, fname)
+                mapped = jnp.where(val > 0, sigma[jnp.clip(val - 1, 0, S - 1)] + 1, 0)
+                nhi, nlo = self.packer.replace(nhi, nlo, fname, mapped)
             nhi = jnp.where(occ, nhi, hi)
             nlo = jnp.where(occ, nlo, lo)
             nhi, nlo, cnt = lax.sort((nhi, nlo, cnt), num_keys=2)

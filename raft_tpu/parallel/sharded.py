@@ -71,7 +71,7 @@ class ShardedBFS:
         self.route_cap = route_cap or max(256, (chunk * self.A) // self.D)
         self.frontier_cap = frontier_cap
         self.seen_cap = seen_cap
-        self.canon = Canonicalizer(model.layout, model.packer, symmetry=symmetry)
+        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         self.W = model.layout.W
 
         spec = P(AXIS)
