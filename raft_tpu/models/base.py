@@ -29,6 +29,8 @@ import numpy as np
 #   per_server_pair  shape (S, S): new[sigma(a), sigma(b)] = old[a, b]
 #   msg_hi/msg_lo/   shape (M,): the message bag; server-valued fields inside
 #   msg_cnt          the packed key remap, then slots re-sort
+#   msg_word         shape (M,): one word of an N-word bag key (WidePacker);
+#                    declared in word order, word 0 first (sort-major)
 #   aux              VIEW-excluded scalar/vector (must come last)
 KINDS = (
     "scalar",
@@ -39,6 +41,7 @@ KINDS = (
     "msg_hi",
     "msg_lo",
     "msg_cnt",
+    "msg_word",
     "aux",
 )
 

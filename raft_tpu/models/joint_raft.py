@@ -1,0 +1,1457 @@
+"""TPU lowering of the joint-consensus reconfiguration Raft spec.
+
+Reference: ``/root/reference/specifications/standard-raft/
+RaftWithReconfigJointConsensus.tla`` (1,145 lines). Every action kernel
+cites the TLA+ lines it lowers.
+
+Structural deltas vs. models/reconfig_raft.py (the add/remove variant):
+  - log entries carry up to THREE member sets (``OldNewConfigCommand``'s
+    old/new/joint-members, ``:837-842``) — seven parallel lane arrays, the
+    sets as bitmasks;
+  - configs track ``jointConsensus`` plus ``old``/``new``
+    (``ConfigFor:279-290``);
+  - dual quorums while joint: ``BecomeLeader:511-528`` and
+    ``AdvanceCommitIndex:613-653`` need simultaneous majorities of old
+    and new (popcount thresholds over both bitmasks);
+  - the reconfiguration parameter space is pairs of member subsets
+    constrained by ``ReconfigType`` (``IsValidReconfiguration:813-825``);
+    the candidate table enumerates exactly the admitted (add, remove)
+    mask pairs statically;
+  - ``AppendNewConfigToLog:861-876`` fires on the unique committed
+    OldNew entry with no later config command
+    (``CommittedOldNewWithoutNew:232-242``);
+  - ``MaxOneReconfigurationAtATime:1080-1101`` is an adjacency rule over
+    every server's log;
+  - ``ResetWithSameIdentity:391`` is NOT in ``Next`` (commented, ``:988``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import bag
+from ..ops.packing import EMPTY, WidePacker, bits_for
+from .base import Layout
+
+FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
+NIL = 0
+ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
+
+# log-entry commands (:58-60); 0 = empty lane
+CMD_NONE, CMD_APPEND, CMD_OLDNEW, CMD_NEW = range(4)
+CMD_NAMES = {
+    CMD_APPEND: "AppendCommand",
+    CMD_OLDNEW: "OldNewConfigCommand",
+    CMD_NEW: "NewConfigCommand",
+}
+
+RVREQ, RVRESP, AEREQ, AERESP, SNAPREQ, SNAPRESP = 1, 2, 3, 4, 5, 6
+MTYPE_NAMES = {
+    RVREQ: "RequestVoteRequest",
+    RVRESP: "RequestVoteResponse",
+    AEREQ: "AppendEntriesRequest",
+    AERESP: "AppendEntriesResponse",
+    SNAPREQ: "SnapshotRequest",
+    SNAPRESP: "SnapshotResponse",
+}
+RC_OK, RC_STALE, RC_MISMATCH, RC_NEEDSNAP = 1, 2, 3, 4
+RC_NAMES = {
+    RC_OK: "Ok",
+    RC_STALE: "StaleTerm",
+    RC_MISMATCH: "EntryMismatch",
+    RC_NEEDSNAP: "NeedSnapshot",
+}
+
+PENDING_SNAP_REQUEST = -1  # :293
+PENDING_SNAP_RESPONSE = -2  # :294
+
+# Next-disjunct ranks (:966-988), for trace labels.
+(
+    J_RESTART,
+    J_UPDATETERM,
+    J_REQUESTVOTE,
+    J_BECOMELEADER,
+    J_HANDLE_RVREQ,
+    J_HANDLE_RVRESP,
+    J_CLIENTREQUEST,
+    J_ADVANCECOMMIT,
+    J_APPENDENTRIES,
+    J_REJECT_AE,
+    J_ACCEPT_AE,
+    J_HANDLE_AERESP,
+    J_APPEND_OLDNEW,
+    J_APPEND_NEW,
+    J_SENDSNAP,
+    J_HANDLE_SNAPREQ,
+    J_HANDLE_SNAPRESP,
+) = range(17)
+
+ACTION_NAMES = [
+    "Restart",
+    "UpdateTerm",
+    "RequestVote",
+    "BecomeLeader",
+    "HandleRequestVoteRequest",
+    "HandleRequestVoteResponse",
+    "ClientRequest",
+    "AdvanceCommitIndex",
+    "AppendEntries",
+    "RejectAppendEntriesRequest",
+    "AcceptAppendEntriesRequest",
+    "HandleAppendEntriesResponse",
+    "AppendOldNewConfigToLog",
+    "AppendNewConfigToLog",
+    "SendSnapshot",
+    "HandleSnapshotRequest",
+    "HandleSnapshotResponse",
+]
+
+ENTRY_SET_FIELDS = ("old", "new", "members")
+ENTRY_FIELDS = ("term", "cmd", "val", "cid") + ENTRY_SET_FIELDS
+
+
+@dataclass(frozen=True)
+class JointRaftParams:
+    n_servers: int
+    n_values: int
+    init_cluster_size: int
+    max_elections: int
+    max_restarts: int
+    max_reconfigs: int
+    max_values_per_term: int
+    reconfig_type: int
+    msg_slots: int = 112
+
+    @property
+    def max_term(self) -> int:
+        return 1 + self.max_elections
+
+    @property
+    def max_cfg_id(self) -> int:
+        return max(1, self.max_reconfigs)
+
+    @property
+    def max_log(self) -> int:
+        appends = min(self.n_values, self.max_term * self.max_values_per_term)
+        return 1 + appends + 2 * self.max_reconfigs
+
+
+def reconfig_shapes(n_servers: int, reconfig_type: int):
+    """The (addMembers, removeMembers) subset pairs admitted by
+    IsValidReconfiguration (:813-825), as bitmask pairs, deterministic
+    order (matches oracle/joint_oracle.py's enumeration)."""
+    servers = range(n_servers)
+    subsets = []
+    for r in range(n_servers + 1):
+        subsets += [frozenset(c) for c in itertools.combinations(servers, r)]
+
+    def valid(add, remove):
+        if reconfig_type == 2:
+            return len(add) == 1 and len(remove) == 1
+        if reconfig_type == 3:
+            return len(add) > 0 and len(remove) == 0
+        if reconfig_type == 4:
+            return len(add) == 0 and len(remove) > 0
+        return bool(add) or bool(remove)
+
+    out = []
+    for add in subsets:
+        for remove in subsets:
+            if valid(add, remove):
+                out.append(
+                    (sum(1 << x for x in add), sum(1 << x for x in remove))
+                )
+    return out
+
+
+def _entry_widths(p: JointRaftParams) -> list[tuple[str, int]]:
+    tb = bits_for(p.max_term)
+    return [
+        ("term", tb),
+        ("cmd", 2),
+        ("val", bits_for(p.n_values)),
+        ("cid", bits_for(p.max_cfg_id)),
+        ("old", p.n_servers),
+        ("new", p.n_servers),
+        ("members", p.n_servers),
+    ]
+
+
+def _build_layout(p: JointRaftParams, n_words: int) -> Layout:
+    S, V, L, M = p.n_servers, p.n_values, p.max_log, p.msg_slots
+    lay = Layout(S)
+    # VIEW (:144): all aux vars excluded.
+    lay.add("config_id", "per_server", (S,))
+    lay.add("config_joint", "per_server", (S,))
+    lay.add("config_members", "server_bitmask", (S,))
+    lay.add("config_old", "server_bitmask", (S,))
+    lay.add("config_new", "server_bitmask", (S,))
+    lay.add("config_committed", "per_server", (S,))
+    lay.add("currentTerm", "per_server", (S,))
+    lay.add("state", "per_server", (S,))
+    lay.add("votedFor", "per_server_val", (S,))
+    lay.add("votesGranted", "server_bitmask", (S,))
+    lay.add("log_term", "per_server", (S, L))
+    lay.add("log_cmd", "per_server", (S, L))
+    lay.add("log_val", "per_server", (S, L))
+    lay.add("log_cid", "per_server", (S, L))
+    lay.add("log_old", "server_bitmask", (S, L))
+    lay.add("log_new", "server_bitmask", (S, L))
+    lay.add("log_members", "server_bitmask", (S, L))
+    lay.add("log_len", "per_server", (S,))
+    lay.add("commitIndex", "per_server", (S,))
+    lay.add("nextIndex", "per_server_pair", (S, S))  # may hold -1/-2
+    lay.add("matchIndex", "per_server_pair", (S, S))
+    lay.add("pendingResponse", "server_bitmask", (S,))
+    for k in range(n_words):
+        lay.add(f"msg_w{k}", "msg_word", (M,))
+    lay.add("msg_cnt", "msg_cnt", (M,))
+    lay.add("acked", "aux", (V,))
+    lay.add("electionCtr", "aux")
+    lay.add("restartCtr", "aux")
+    lay.add("reconfigCtr", "aux")
+    lay.add("valueCtr", "aux", (p.max_term,))
+    return lay.finish()
+
+
+def _build_packer(p: JointRaftParams) -> WidePacker:
+    tb = bits_for(p.max_term)
+    sb = bits_for(p.n_servers - 1)
+    lb = bits_for(p.max_log + 1)
+    ew = _entry_widths(p)
+    fields = [
+        ("mtype", 3),
+        ("mterm", tb),
+        ("msource", sb),
+        ("mdest", sb),
+        ("mlastLogTerm", tb),
+        ("mlastLogIndex", lb),
+        ("mvoteGranted", 1),
+        ("mprevLogIndex", lb),
+        ("mprevLogTerm", tb),
+        ("nentries", 1),
+        *[(f"e_{n}", w) for n, w in ew],
+        ("mcommitIndex", lb),
+        ("mresult", 3),
+        ("mmatchIndex", lb),
+        ("msuccess", 1),
+        ("mloglen", lb),
+        ("mmembers", p.n_servers),
+        *[(f"l{k}_{n}", w) for k in range(p.max_log) for n, w in ew],
+    ]
+    for n_words in range(2, 16):
+        try:
+            return WidePacker(fields, n_words)
+        except ValueError:
+            continue
+    raise ValueError("message schema too wide")
+
+
+def cached_model(params: "JointRaftParams") -> "JointRaftModel":
+    return _cached_model(params)
+
+
+class JointRaftModel:
+    """Vectorized successor/invariant kernels for one (spec, constants) pair."""
+
+    name = "RaftWithReconfigJointConsensus"
+
+    def __init__(self, params, server_names=None, value_names=None):
+        self.p = params
+        self.packer = _build_packer(params)
+        self.n_words = self.packer.n_words
+        self.layout = _build_layout(params, self.n_words)
+        S, V, M, L = params.n_servers, params.n_values, params.msg_slots, params.max_log
+        self.server_names = list(server_names or [f"s{i+1}" for i in range(S)])
+        self.value_names = list(value_names or [f"v{i+1}" for i in range(V)])
+
+        spec = [("msource", "server"), ("mdest", "server"),
+                ("mmembers", "server_bitmask")]
+        for n in ENTRY_SET_FIELDS:
+            spec.append((f"e_{n}", "server_bitmask"))
+        for k in range(L):
+            for n in ENTRY_SET_FIELDS:
+                spec.append((f"l{k}_{n}", "server_bitmask"))
+        self.msg_perm_spec = tuple(spec)
+
+        self.shapes = reconfig_shapes(S, params.reconfig_type)
+        self.bindings: list[tuple[str, tuple]] = []
+        self._pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
+        for i in range(S):
+            self.bindings.append(("Restart", (i,)))
+        for i in range(S):
+            self.bindings.append(("RequestVote", (i,)))
+        for i in range(S):
+            self.bindings.append(("BecomeLeader", (i,)))
+        for i in range(S):
+            for v in range(V):
+                self.bindings.append(("ClientRequest", (i, v)))
+        for i in range(S):
+            self.bindings.append(("AdvanceCommitIndex", (i,)))
+        for ij in self._pairs:
+            self.bindings.append(("AppendEntries", ij))
+        for i in range(S):
+            for add_m, rem_m in self.shapes:
+                self.bindings.append(("AppendOldNewConfigToLog", (i, add_m, rem_m)))
+        for i in range(S):
+            self.bindings.append(("AppendNewConfigToLog", (i,)))
+        for ij in self._pairs:
+            self.bindings.append(("SendSnapshot", ij))
+        for m in range(M):
+            self.bindings.append(("HandleMessage", (m,)))
+        self.A = len(self.bindings)
+
+        self.expand = jax.jit(jax.vmap(self._expand1))
+        self.invariants = {
+            "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
+            "MaxOneReconfigurationAtATime": jax.jit(self._inv_max_one_reconfig),
+            "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
+            "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
+            "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
+        }
+
+    def action_label(self, rank: int, cand: int) -> str:
+        name, binding = self.bindings[cand]
+        if name == "HandleMessage":
+            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
+        return f"{name}{binding}"
+
+    # ---------------- field access helpers ----------------
+
+    def _dec(self, s):
+        g = self.layout.get
+        return {f: g(s, f) for f in self.layout.fields}
+
+    def _asm(self, d, **updates):
+        parts = []
+        for name, f in self.layout.fields.items():
+            arr = updates.get(name, d[name])
+            arr = jnp.asarray(arr, jnp.int32)
+            parts.append(arr.reshape(-1) if f.shape else arr.reshape(1))
+        return jnp.concatenate(parts)
+
+    def _pack(self, **vals):
+        return tuple(jnp.asarray(w, jnp.int32) for w in self.packer.pack(**vals))
+
+    def _words(self, d):
+        return [d[f"msg_w{k}"] for k in range(self.n_words)]
+
+    def _bag_put(self, words, cnt, key):
+        return bag.wide_bag_put(words, cnt, key)
+
+    def _word_upd(self, words, cnt):
+        upd = {f"msg_w{k}": w for k, w in enumerate(words)}
+        upd["msg_cnt"] = cnt
+        return upd
+
+    @staticmethod
+    def _last_term(d, i):
+        ll = d["log_len"][i]
+        return jnp.where(ll > 0, d["log_term"][i][jnp.clip(ll - 1, 0)], 0)
+
+    @staticmethod
+    def _popcount(x, S):
+        return jnp.sum((x >> jnp.arange(S, dtype=jnp.int32)) & 1)
+
+    def _mrce(self, d, i):
+        """MostRecentReconfigEntry — :251-257. Returns (index, cmd, cid,
+        old, new, members) of the latest config command."""
+        L = self.p.max_log
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        cmd = d["log_cmd"][i]
+        is_cfg = (cmd == CMD_OLDNEW) | (cmd == CMD_NEW)
+        mask = (lanes < d["log_len"][i]) & is_cfg
+        idx = jnp.max(jnp.where(mask, lanes + 1, 0))
+        pos = jnp.clip(idx - 1, 0)
+        return (
+            idx,
+            cmd[pos],
+            d["log_cid"][i][pos],
+            d["log_old"][i][pos],
+            d["log_new"][i][pos],
+            d["log_members"][i][pos],
+        )
+
+    def _config_for_upd(self, d, i, idx, cmd, cid, old, new, members, ci):
+        """ConfigFor (:279-290) applied to server i's config fields."""
+        joint = (cmd == CMD_OLDNEW).astype(jnp.int32)
+        z = jnp.int32(0)
+        return dict(
+            config_id=d["config_id"].at[i].set(cid),
+            config_joint=d["config_joint"].at[i].set(joint),
+            config_members=d["config_members"].at[i].set(members),
+            config_old=d["config_old"].at[i].set(jnp.where(joint > 0, old, z)),
+            config_new=d["config_new"].at[i].set(jnp.where(joint > 0, new, z)),
+            config_committed=d["config_committed"].at[i].set(
+                (ci >= idx).astype(jnp.int32)
+            ),
+        )
+
+    # ---------------- action kernels ----------------
+
+    def _restart(self, s, i):
+        """Restart(i) — :362-374."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        valid = d["restartCtr"] < p.max_restarts
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(FOLLOWER),
+            votesGranted=d["votesGranted"].at[i].set(0),
+            nextIndex=d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
+            matchIndex=d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            pendingResponse=d["pendingResponse"].at[i].set(0),
+            commitIndex=d["commitIndex"].at[i].set(0),
+            restartCtr=d["restartCtr"] + 1,
+        )
+        return valid, succ, jnp.int32(J_RESTART), jnp.asarray(False)
+
+    def _request_vote(self, s, i):
+        """RequestVote(i) — :431-450."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        st_i = d["state"][i]
+        members = d["config_members"][i]
+        valid = (
+            (d["electionCtr"] < p.max_elections)
+            & ((st_i == FOLLOWER) | (st_i == CANDIDATE))
+            & (((members >> i) & 1) > 0)
+        )
+        new_term = d["currentTerm"][i] + 1
+        last_t = self._last_term(d, i)
+        ll_i = d["log_len"][i]
+        words, cnt = self._words(d), d["msg_cnt"]
+        ovf = jnp.asarray(False)
+        for delta in range(1, S):
+            j = jnp.mod(i + delta, S)
+            is_member = ((members >> j) & 1) > 0
+            key = self._pack(
+                mtype=RVREQ,
+                mterm=new_term,
+                mlastLogTerm=last_t,
+                mlastLogIndex=ll_i,
+                msource=i,
+                mdest=j,
+            )
+            w2, c2, existed, o = self._bag_put(words, cnt, key)
+            valid &= (~is_member) | ~existed
+            ovf |= is_member & o
+            words = [jnp.where(is_member, a, b) for a, b in zip(w2, words)]
+            cnt = jnp.where(is_member, c2, cnt)
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(CANDIDATE),
+            currentTerm=d["currentTerm"].at[i].set(new_term),
+            votedFor=d["votedFor"].at[i].set(i + 1),
+            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
+            electionCtr=d["electionCtr"] + 1,
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(J_REQUESTVOTE), ovf & valid
+
+    def _become_leader(self, s, i):
+        """BecomeLeader(i) — :511-528: dual quorums while joint."""
+        S = self.p.n_servers
+        d = self._dec(s)
+        vg = d["votesGranted"][i]
+        joint = d["config_joint"][i] > 0
+        members = d["config_members"][i]
+        old = d["config_old"][i]
+        new = d["config_new"][i]
+        q_plain = ((vg & ~members) == 0) & (
+            2 * self._popcount(vg, S) > self._popcount(members, S)
+        )
+        q_old = 2 * self._popcount(vg & old, S) > self._popcount(old, S)
+        q_new = 2 * self._popcount(vg & new, S) > self._popcount(new, S)
+        valid = (d["state"][i] == CANDIDATE) & jnp.where(
+            joint, q_old & q_new, q_plain
+        )
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(LEADER),
+            nextIndex=d["nextIndex"].at[i].set(
+                jnp.full((S,), 1, jnp.int32) * (d["log_len"][i] + 1)
+            ),
+            matchIndex=d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            pendingResponse=d["pendingResponse"].at[i].set(0),
+        )
+        return valid, succ, jnp.int32(J_BECOMELEADER), jnp.asarray(False)
+
+    def _client_request(self, s, i, v):
+        """ClientRequest(i, v) — :535-550."""
+        p, L = self.p, self.p.max_log
+        d = self._dec(s)
+        term = d["currentTerm"][i]
+        tpos = jnp.clip(term - 1, 0, p.max_term - 1)
+        valid = (
+            (d["state"][i] == LEADER)
+            & (d["acked"][v] == ACK_NIL)
+            & (d["valueCtr"][tpos] < p.max_values_per_term)
+        )
+        pos = d["log_len"][i]
+        ovf = valid & (pos >= L)
+        posc = jnp.clip(pos, 0, L - 1)
+        succ = self._asm(
+            d,
+            log_term=d["log_term"].at[i, posc].set(term),
+            log_cmd=d["log_cmd"].at[i, posc].set(CMD_APPEND),
+            log_val=d["log_val"].at[i, posc].set(v + 1),
+            log_len=d["log_len"].at[i].add(1),
+            acked=d["acked"].at[v].set(ACK_FALSE),
+            valueCtr=d["valueCtr"].at[tpos].add(1),
+        )
+        return valid, succ, jnp.int32(J_CLIENTREQUEST), ovf
+
+    def _advance_commit_index(self, s, i):
+        """AdvanceCommitIndex(i) — :613-653: dual-quorum agreement while
+        joint (:626-629)."""
+        p = self.p
+        S, L, V = p.n_servers, p.max_log, p.n_values
+        d = self._dec(s)
+        joint = d["config_joint"][i] > 0
+        ll_i = d["log_len"][i]
+        ci_i = d["commitIndex"][i]
+        match_row = d["matchIndex"][i]
+        idxs = jnp.arange(1, L + 1, dtype=jnp.int32)
+        ks = jnp.arange(S, dtype=jnp.int32)
+
+        def quorum_over(member_mask):
+            member_k = ((member_mask >> ks) & 1) > 0
+            in_agree = member_k[None, :] & (
+                (match_row[None, :] >= idxs[:, None]) | (ks[None, :] == i)
+            )
+            return 2 * jnp.sum(in_agree, axis=1) > self._popcount(member_mask, S)
+
+        q_plain = quorum_over(d["config_members"][i])
+        q_joint = quorum_over(d["config_old"][i]) & quorum_over(d["config_new"][i])
+        quorum_ok = jnp.where(joint, q_joint, q_plain)
+        is_agree = quorum_ok & (idxs <= ll_i)
+        max_agree = jnp.max(jnp.where(is_agree, idxs, 0))
+        term_at = d["log_term"][i][jnp.clip(max_agree - 1, 0)]
+        new_ci = jnp.where(
+            (max_agree > 0) & (term_at == d["currentTerm"][i]), max_agree, ci_i
+        )
+        valid = (d["state"][i] == LEADER) & (ci_i < new_ci)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_range = (lanes + 1 > ci_i) & (lanes + 1 <= new_ci)
+        vals_row = jnp.where(d["log_cmd"][i] == CMD_APPEND, d["log_val"][i], 0)
+        committed = jnp.any(
+            in_range[None, :]
+            & (vals_row[None, :] == jnp.arange(1, V + 1, dtype=jnp.int32)[:, None]),
+            axis=1,
+        )
+        acked = jnp.where((d["acked"] == ACK_FALSE) & committed, ACK_TRUE, d["acked"])
+        idx, cmd, cid, c_old, c_new, c_members = self._mrce(d, i)
+        upd = self._config_for_upd(
+            d, i, idx, cmd, cid, c_old, c_new, c_members, new_ci
+        )
+        upd["acked"] = acked
+        # IsRemovedFromCluster (:606-611)
+        removed = jnp.any(
+            in_range
+            & (d["log_cmd"][i] == CMD_NEW)
+            & (((d["log_members"][i] >> i) & 1) == 0)
+        )
+        upd["state"] = jnp.where(removed, d["state"].at[i].set(NOTMEMBER), d["state"])
+        upd["votesGranted"] = jnp.where(
+            removed, d["votesGranted"].at[i].set(0), d["votesGranted"]
+        )
+        upd["nextIndex"] = jnp.where(
+            removed,
+            d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
+            d["nextIndex"],
+        )
+        upd["matchIndex"] = jnp.where(
+            removed,
+            d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            d["matchIndex"],
+        )
+        upd["commitIndex"] = jnp.where(
+            removed,
+            d["commitIndex"].at[i].set(0),
+            d["commitIndex"].at[i].set(new_ci),
+        )
+        succ = self._asm(d, **upd)
+        return valid, succ, jnp.int32(J_ADVANCECOMMIT), jnp.asarray(False)
+
+    def _append_entries(self, s, i, j):
+        """AppendEntries(i, j) — :556-582."""
+        p = self.p
+        L = p.max_log
+        d = self._dec(s)
+        ni_ij = d["nextIndex"][i, j]
+        valid = (
+            (d["state"][i] == LEADER)
+            & (((d["config_members"][i] >> j) & 1) > 0)
+            & (ni_ij >= 0)
+            & (((d["pendingResponse"][i] >> j) & 1) == 0)
+        )
+        prev_idx = ni_ij - 1
+        prev_term = jnp.where(
+            prev_idx > 0, d["log_term"][i][jnp.clip(prev_idx - 1, 0, L - 1)], 0
+        )
+        last_entry = jnp.minimum(d["log_len"][i], ni_ij)
+        nent = (last_entry >= ni_ij).astype(jnp.int32)
+        epos = jnp.clip(ni_ij - 1, 0, L - 1)
+        z = jnp.int32(0)
+        kw = dict(
+            mtype=AEREQ,
+            mterm=d["currentTerm"][i],
+            mprevLogIndex=jnp.clip(prev_idx, 0),
+            mprevLogTerm=prev_term,
+            nentries=nent,
+            mcommitIndex=jnp.clip(jnp.minimum(d["commitIndex"][i], last_entry), 0),
+            msource=i,
+            mdest=j,
+        )
+        for n in ENTRY_FIELDS:
+            kw[f"e_{n}"] = jnp.where(nent > 0, d[f"log_{n}"][i][epos], z)
+        key = self._pack(**kw)
+        words, cnt, existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
+        valid &= (nent > 0) | ~existed  # empty AEReq is send-once (:177-181)
+        succ = self._asm(
+            d,
+            pendingResponse=d["pendingResponse"].at[i].set(
+                d["pendingResponse"][i] | (jnp.int32(1) << j)
+            ),
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(J_APPENDENTRIES), ovf & valid
+
+    def _append_old_new(self, s, i, add_mask, rem_mask):
+        """AppendOldNewConfigToLog(i) for one admitted (add, remove) subset
+        pair — :827-856."""
+        p, S, L = self.p, self.p.n_servers, self.p.max_log
+        d = self._dec(s)
+        members = d["config_members"][i]
+        add_m = jnp.int32(add_mask)
+        rem_m = jnp.int32(rem_mask)
+        # HasPendingConfigCommand (:246-248)
+        pending = (d["config_committed"][i] == 0) | (d["config_joint"][i] > 0)
+        valid = (
+            (d["state"][i] == LEADER)
+            & (d["reconfigCtr"] < p.max_reconfigs)
+            & ~pending
+            & ((add_m & members) == 0)  # addMembers disjoint (:834)
+            & ((rem_m & members) == rem_m)  # removeMembers subset (:835)
+        )
+        old = members
+        new = (members & ~rem_m) | add_m
+        joint_members = members | add_m
+        new_id = d["reconfigCtr"] + 1  # id = reconfigCtr + 1 (:839)
+        pos = d["log_len"][i]
+        ovf = valid & (pos >= L)
+        posc = jnp.clip(pos, 0, L - 1)
+        # nextIndex := PendingSnapshotRequest for s in new \ old (:849-853)
+        ks = jnp.arange(S, dtype=jnp.int32)
+        fresh = (((new >> ks) & 1) > 0) & (((old >> ks) & 1) == 0)
+        ni_row = jnp.where(
+            fresh, jnp.int32(PENDING_SNAP_REQUEST), d["nextIndex"][i]
+        )
+        succ = self._asm(
+            d,
+            log_term=d["log_term"].at[i, posc].set(d["currentTerm"][i]),
+            log_cmd=d["log_cmd"].at[i, posc].set(CMD_OLDNEW),
+            log_cid=d["log_cid"].at[i, posc].set(new_id),
+            log_old=d["log_old"].at[i, posc].set(old),
+            log_new=d["log_new"].at[i, posc].set(new),
+            log_members=d["log_members"].at[i, posc].set(joint_members),
+            log_len=d["log_len"].at[i].add(1),
+            config_id=d["config_id"].at[i].set(new_id),
+            config_joint=d["config_joint"].at[i].set(1),
+            config_members=d["config_members"].at[i].set(joint_members),
+            config_old=d["config_old"].at[i].set(old),
+            config_new=d["config_new"].at[i].set(new),
+            config_committed=d["config_committed"].at[i].set(
+                (d["commitIndex"][i] >= pos + 1).astype(jnp.int32)
+            ),
+            reconfigCtr=d["reconfigCtr"] + 1,
+            nextIndex=d["nextIndex"].at[i].set(ni_row),
+        )
+        return valid, succ, jnp.int32(J_APPEND_OLDNEW), ovf
+
+    def _append_new(self, s, i):
+        """AppendNewConfigToLog(i) — :861-876: fires on the unique
+        committed OldNew with no later config command."""
+        p, L = self.p, self.p.max_log
+        d = self._dec(s)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        cmd_row = d["log_cmd"][i]
+        ll_i = d["log_len"][i]
+        in_log = lanes < ll_i
+        is_oldnew = in_log & (cmd_row == CMD_OLDNEW)
+        is_new = in_log & (cmd_row == CMD_NEW)
+        last_oldnew = jnp.max(jnp.where(is_oldnew, lanes + 1, 0))
+        last_new = jnp.max(jnp.where(is_new, lanes + 1, 0))
+        # CommittedOldNewWithoutNew (:232-242)
+        qualifies = (
+            (last_oldnew > 0)
+            & (d["commitIndex"][i] >= last_oldnew)
+            & (last_new < last_oldnew)
+        )
+        valid = (d["state"][i] == LEADER) & qualifies
+        tpos = jnp.clip(last_oldnew - 1, 0)
+        new_members = d["log_new"][i][tpos]
+        new_id = d["log_cid"][i][tpos]
+        pos = ll_i
+        ovf = valid & (pos >= L)
+        posc = jnp.clip(pos, 0, L - 1)
+        succ = self._asm(
+            d,
+            log_term=d["log_term"].at[i, posc].set(d["currentTerm"][i]),
+            log_cmd=d["log_cmd"].at[i, posc].set(CMD_NEW),
+            log_cid=d["log_cid"].at[i, posc].set(new_id),
+            log_members=d["log_members"].at[i, posc].set(new_members),
+            log_len=d["log_len"].at[i].add(1),
+            config_id=d["config_id"].at[i].set(new_id),
+            config_joint=d["config_joint"].at[i].set(0),
+            config_members=d["config_members"].at[i].set(new_members),
+            config_old=d["config_old"].at[i].set(0),
+            config_new=d["config_new"].at[i].set(0),
+            config_committed=d["config_committed"].at[i].set(
+                (d["commitIndex"][i] >= pos + 1).astype(jnp.int32)
+            ),
+        )
+        return valid, succ, jnp.int32(J_APPEND_NEW), ovf
+
+    def _send_snapshot(self, s, i, j):
+        """SendSnapshot(i, j) — :885-901."""
+        p, L = self.p, self.p.max_log
+        d = self._dec(s)
+        valid = (
+            (d["state"][i] == LEADER)
+            & (((d["config_members"][i] >> j) & 1) > 0)
+            & (d["nextIndex"][i, j] == PENDING_SNAP_REQUEST)
+        )
+        kw = dict(
+            mtype=SNAPREQ,
+            mterm=d["currentTerm"][i],
+            mcommitIndex=d["commitIndex"][i],
+            mmembers=d["config_members"][i],
+            mloglen=d["log_len"][i],
+            msource=i,
+            mdest=j,
+        )
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        live = lanes < d["log_len"][i]
+        for k in range(L):
+            for n in ENTRY_FIELDS:
+                kw[f"l{k}_{n}"] = jnp.where(live[k], d[f"log_{n}"][i][k], 0)
+        key = self._pack(**kw)
+        words, cnt, _existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
+        succ = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[i, j].set(PENDING_SNAP_RESPONSE),
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(J_SENDSNAP), ovf & valid
+
+    # -------- fused message-receipt kernel (slot m) --------
+
+    def _handle_message(self, s, m):
+        p = self.p
+        S, L = p.n_servers, p.max_log
+        d = self._dec(s)
+        words, cnt = self._words(d), d["msg_cnt"]
+        key = [w[m] for w in words]
+        kcnt = cnt[m]
+        occupied = key[0] != EMPTY
+        u = lambda n: self.packer.unpack(key, n)
+        mtype, mterm = u("mtype"), u("mterm")
+        src, dst = u("msource"), u("mdest")
+        cur = d["currentTerm"][dst]
+        st_dst = d["state"][dst]
+        member_dst = ((d["config_members"][dst] >> dst) & 1) > 0
+        recv = occupied & (kcnt > 0)
+        le_term = mterm <= cur
+        eq_term = mterm == cur
+        cnt_disc = bag.bag_discard_at(cnt, m)
+
+        def reply(resp_key):
+            return self._bag_put(words, cnt_disc, resp_key)
+
+        # --- UpdateTerm (:410-419)
+        b_upd = occupied & (mterm > cur)
+        s_upd = self._asm(
+            d,
+            currentTerm=d["currentTerm"].at[dst].set(mterm),
+            state=d["state"].at[dst].set(FOLLOWER),
+            votedFor=d["votedFor"].at[dst].set(NIL),
+        )
+
+        # --- HandleRequestVoteRequest (:455-478)
+        last_t = self._last_term(d, dst)
+        ll_dst = d["log_len"][dst]
+        rv_logok = (u("mlastLogTerm") > last_t) | (
+            (u("mlastLogTerm") == last_t) & (u("mlastLogIndex") >= ll_dst)
+        )
+        grant = (
+            eq_term
+            & rv_logok
+            & ((d["votedFor"][dst] == NIL) | (d["votedFor"][dst] == src + 1))
+        )
+        b_rvreq = recv & (mtype == RVREQ) & le_term
+        rv_key = self._pack(
+            mtype=RVRESP,
+            mterm=cur,
+            mvoteGranted=grant.astype(jnp.int32),
+            msource=dst,
+            mdest=src,
+        )
+        w1, c1, _ex1, ovf1 = reply(rv_key)
+        s_rvreq = self._asm(
+            d,
+            votedFor=jnp.where(
+                grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]
+            ),
+            **self._word_upd(w1, c1),
+        )
+
+        # --- HandleRequestVoteResponse (:483-499)
+        b_rvresp = recv & (mtype == RVRESP) & eq_term & (st_dst == CANDIDATE)
+        vg = jnp.where(
+            u("mvoteGranted") > 0,
+            d["votesGranted"].at[dst].set(
+                d["votesGranted"][dst] | (jnp.int32(1) << src)
+            ),
+            d["votesGranted"],
+        )
+        s_rvresp = self._asm(d, votesGranted=vg, msg_cnt=cnt_disc)
+
+        # --- AppendEntries request handling
+        prev_idx = u("mprevLogIndex")
+        prev_term = u("mprevLogTerm")
+        nent = u("nentries")
+        lt_row = d["log_term"][dst]
+        at_prev = lt_row[jnp.clip(prev_idx - 1, 0, L - 1)]
+        ae_logok = jnp.where(
+            nent > 0,
+            (prev_idx > 0) & (prev_idx <= ll_dst) & (prev_term == at_prev),
+            (prev_idx == ll_dst) & (prev_idx > 0) & (prev_term == at_prev),
+        )
+        rc = jnp.where(
+            mterm < cur,
+            RC_STALE,
+            jnp.where(
+                ~member_dst,
+                RC_NEEDSNAP,
+                jnp.where(
+                    eq_term & (st_dst == FOLLOWER) & ~ae_logok, RC_MISMATCH, RC_OK
+                ),
+            ),
+        )
+
+        # RejectAppendEntriesRequest (:679-703)
+        b_reject = recv & (mtype == AEREQ) & le_term & (rc != RC_OK)
+        rj_key = self._pack(
+            mtype=AERESP,
+            mterm=cur,
+            mresult=rc,
+            mmatchIndex=0,
+            msource=dst,
+            mdest=src,
+        )
+        w2, c2, _ex2, ovf2 = reply(rj_key)
+        s_reject = self._asm(d, **self._word_upd(w2, c2))
+
+        # AcceptAppendEntriesRequest (:726-763)
+        b_accept = (
+            recv
+            & (mtype == AEREQ)
+            & eq_term
+            & ((st_dst == FOLLOWER) | (st_dst == CANDIDATE))
+            & ae_logok
+            & member_dst
+        )
+        can_append = (nent != 0) & (ll_dst == prev_idx)
+        needs_trunc = (nent != 0) & (ll_dst >= prev_idx + 1)
+        appending = can_append | needs_trunc
+        new_ll = jnp.where(appending, prev_idx + 1, ll_dst)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        keep = lanes < prev_idx
+        app_pos = jnp.clip(prev_idx, 0, L - 1)
+        new_logs = {}
+        for n in ENTRY_FIELDS:
+            row = d[f"log_{n}"][dst]
+            nrow = jnp.where(keep, row, 0).at[app_pos].set(
+                jnp.where(appending, u(f"e_{n}"), 0)
+            )
+            new_logs[n] = jnp.where(appending, nrow, row)
+        is_cfg = (new_logs["cmd"] == CMD_OLDNEW) | (new_logs["cmd"] == CMD_NEW)
+        cfg_mask = (lanes < new_ll) & is_cfg
+        cfg_idx = jnp.max(jnp.where(cfg_mask, lanes + 1, 0))
+        cfg_pos = jnp.clip(cfg_idx - 1, 0)
+        mci = u("mcommitIndex")
+        cfg_cmd = new_logs["cmd"][cfg_pos]
+        cfg_joint = (cfg_cmd == CMD_OLDNEW).astype(jnp.int32)
+        cfg_members = new_logs["members"][cfg_pos]
+        in_new = ((cfg_members >> dst) & 1) > 0
+        z = jnp.int32(0)
+        ac_ovf = b_accept & appending & (prev_idx >= L)
+        ac_key = self._pack(
+            mtype=AERESP,
+            mterm=cur,
+            mresult=RC_OK,
+            mmatchIndex=prev_idx + nent,
+            msource=dst,
+            mdest=src,
+        )
+        w3, c3, _ex3, ovf3 = reply(ac_key)
+        upd3 = dict(
+            config_id=d["config_id"].at[dst].set(new_logs["cid"][cfg_pos]),
+            config_joint=d["config_joint"].at[dst].set(cfg_joint),
+            config_members=d["config_members"].at[dst].set(cfg_members),
+            config_old=d["config_old"].at[dst].set(
+                jnp.where(cfg_joint > 0, new_logs["old"][cfg_pos], z)
+            ),
+            config_new=d["config_new"].at[dst].set(
+                jnp.where(cfg_joint > 0, new_logs["new"][cfg_pos], z)
+            ),
+            config_committed=d["config_committed"].at[dst].set(
+                (mci >= cfg_idx).astype(jnp.int32)
+            ),
+            commitIndex=d["commitIndex"].at[dst].set(mci),
+            state=d["state"].at[dst].set(jnp.where(in_new, FOLLOWER, NOTMEMBER)),
+            log_len=d["log_len"].at[dst].set(new_ll),
+            **self._word_upd(w3, c3),
+        )
+        for n in ENTRY_FIELDS:
+            upd3[f"log_{n}"] = d[f"log_{n}"].at[dst].set(new_logs[n])
+        s_accept = self._asm(d, **upd3)
+
+        # --- HandleAppendEntriesResponse (:768-798)
+        b_aeresp = recv & (mtype == AERESP) & eq_term & (st_dst == LEADER)
+        res = u("mresult")
+        mmatch = u("mmatchIndex")
+        ni_cur = d["nextIndex"][dst, src]
+        ni_new = jnp.where(
+            res == RC_OK,
+            mmatch + 1,
+            jnp.where(
+                res == RC_MISMATCH,
+                jnp.maximum(ni_cur - 1, 1),
+                jnp.where(res == RC_NEEDSNAP, PENDING_SNAP_REQUEST, ni_cur),
+            ),
+        )
+        mi_new = jnp.where(
+            res == RC_OK, d["matchIndex"].at[dst, src].set(mmatch), d["matchIndex"]
+        )
+        s_aeresp = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[dst, src].set(ni_new),
+            matchIndex=mi_new,
+            pendingResponse=d["pendingResponse"].at[dst].set(
+                d["pendingResponse"][dst] & ~(jnp.int32(1) << src)
+            ),
+            msg_cnt=cnt_disc,
+        )
+
+        # --- HandleSnapshotRequest (:905-927)
+        b_snapreq = recv & (mtype == SNAPREQ) & eq_term & (st_dst == FOLLOWER)
+        sn_ll = u("mloglen")
+        sn_logs = {
+            n: jnp.stack([u(f"l{k}_{n}") for k in range(L)]) for n in ENTRY_FIELDS
+        }
+        sn_is_cfg = (sn_logs["cmd"] == CMD_OLDNEW) | (sn_logs["cmd"] == CMD_NEW)
+        sn_mask = (lanes < sn_ll) & sn_is_cfg
+        sn_idx = jnp.max(jnp.where(sn_mask, lanes + 1, 0))
+        sn_pos = jnp.clip(sn_idx - 1, 0)
+        sn_mci = u("mcommitIndex")
+        sn_cmd = sn_logs["cmd"][sn_pos]
+        sn_joint = (sn_cmd == CMD_OLDNEW).astype(jnp.int32)
+        sq_key = self._pack(
+            mtype=SNAPRESP,
+            mterm=cur,
+            msuccess=1,
+            mmatchIndex=sn_ll,
+            msource=dst,
+            mdest=src,
+        )
+        w4, c4, _ex4, ovf4 = reply(sq_key)
+        upd4 = dict(
+            commitIndex=d["commitIndex"].at[dst].set(sn_mci),
+            log_len=d["log_len"].at[dst].set(sn_ll),
+            config_id=d["config_id"].at[dst].set(sn_logs["cid"][sn_pos]),
+            config_joint=d["config_joint"].at[dst].set(sn_joint),
+            config_members=d["config_members"].at[dst].set(
+                sn_logs["members"][sn_pos]
+            ),
+            config_old=d["config_old"].at[dst].set(
+                jnp.where(sn_joint > 0, sn_logs["old"][sn_pos], z)
+            ),
+            config_new=d["config_new"].at[dst].set(
+                jnp.where(sn_joint > 0, sn_logs["new"][sn_pos], z)
+            ),
+            config_committed=d["config_committed"].at[dst].set(
+                (sn_mci >= sn_idx).astype(jnp.int32)
+            ),
+            **self._word_upd(w4, c4),
+        )
+        for n in ENTRY_FIELDS:
+            upd4[f"log_{n}"] = d[f"log_{n}"].at[dst].set(sn_logs[n])
+        s_snapreq = self._asm(d, **upd4)
+
+        # --- HandleSnapshotResponse (:932-944)
+        b_snapresp = (
+            recv
+            & (mtype == SNAPRESP)
+            & eq_term
+            & (d["nextIndex"][dst, src] == PENDING_SNAP_RESPONSE)
+        )
+        s_snapresp = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[dst, src].set(u("mmatchIndex") + 1),
+            matchIndex=d["matchIndex"].at[dst, src].set(u("mmatchIndex")),
+            msg_cnt=cnt_disc,
+        )
+
+        branches = [
+            (b_upd, s_upd, J_UPDATETERM, jnp.asarray(False)),
+            (b_rvreq, s_rvreq, J_HANDLE_RVREQ, ovf1),
+            (b_rvresp, s_rvresp, J_HANDLE_RVRESP, jnp.asarray(False)),
+            (b_reject, s_reject, J_REJECT_AE, ovf2),
+            (b_accept, s_accept, J_ACCEPT_AE, ovf3 | ac_ovf),
+            (b_aeresp, s_aeresp, J_HANDLE_AERESP, jnp.asarray(False)),
+            (b_snapreq, s_snapreq, J_HANDLE_SNAPREQ, ovf4),
+            (b_snapresp, s_snapresp, J_HANDLE_SNAPRESP, jnp.asarray(False)),
+        ]
+        valid = jnp.asarray(False)
+        succ = s
+        rank = jnp.int32(-1)
+        ovf = jnp.asarray(False)
+        for b, sb, rk, ob in branches:
+            valid = valid | b
+            succ = jnp.where(b, sb, succ)
+            rank = jnp.where(b, jnp.int32(rk), rank)
+            ovf = ovf | (b & ob)
+        return valid, succ, rank, ovf
+
+    # ---------------- full expansion ----------------
+
+    def _expand1(self, s):
+        p = self.p
+        S, V, M = p.n_servers, p.n_values, p.msg_slots
+        iota_s = jnp.arange(S, dtype=jnp.int32)
+        pr_i = jnp.asarray([ij[0] for ij in self._pairs], jnp.int32)
+        pr_j = jnp.asarray([ij[1] for ij in self._pairs], jnp.int32)
+        outs = []
+        outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
+        cr_i = jnp.repeat(iota_s, V)
+        cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
+        outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
+        outs.append(jax.vmap(lambda i: self._advance_commit_index(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i, j: self._append_entries(s, i, j))(pr_i, pr_j))
+        on_i = jnp.asarray(
+            [i for i in range(S) for _ in self.shapes], jnp.int32
+        )
+        on_add = jnp.asarray(
+            [a for _ in range(S) for a, _r in self.shapes], jnp.int32
+        )
+        on_rem = jnp.asarray(
+            [r for _ in range(S) for _a, r in self.shapes], jnp.int32
+        )
+        outs.append(
+            jax.vmap(lambda i, a, r: self._append_old_new(s, i, a, r))(
+                on_i, on_add, on_rem
+            )
+        )
+        outs.append(jax.vmap(lambda i: self._append_new(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i, j: self._send_snapshot(s, i, j))(pr_i, pr_j))
+        outs.append(
+            jax.vmap(lambda m: self._handle_message(s, m))(
+                jnp.arange(M, dtype=jnp.int32)
+            )
+        )
+        valid = jnp.concatenate([o[0] for o in outs])
+        succs = jnp.concatenate([o[1] for o in outs])
+        rank = jnp.concatenate([o[2] for o in outs])
+        ovf = jnp.concatenate([o[3] for o in outs])
+        return succs, valid, rank, ovf
+
+    # ---------------- initial states ----------------
+
+    def init_states(self) -> np.ndarray:
+        """Init — :341-354: pre-installed cluster seeded with a
+        NewConfigCommand; CHOOSE realized as lowest indices."""
+        p = self.p
+        S = p.n_servers
+        lay = self.layout
+        vec = lay.zeros((1,))
+        members = list(range(p.init_cluster_size))
+        mask = sum(1 << i for i in members)
+        leader = 0
+        vec[0, lay.sl("config_id")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("config_members")] = [
+            mask if i in members else 0 for i in range(S)
+        ]
+        vec[0, lay.sl("config_committed")] = [
+            1 if i in members else 0 for i in range(S)
+        ]
+        vec[0, lay.sl("currentTerm")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("state")] = [
+            LEADER if i == leader else FOLLOWER if i in members else NOTMEMBER
+            for i in range(S)
+        ]
+        ni = np.ones((S, S), np.int32)
+        mi = np.zeros((S, S), np.int32)
+        for j in members:
+            ni[leader, j] = 2
+            mi[leader, j] = 1
+        vec[0, lay.sl("nextIndex")] = ni.reshape(-1)
+        vec[0, lay.sl("matchIndex")] = mi.reshape(-1)
+        lt = np.zeros((S, p.max_log), np.int32)
+        lc = np.zeros((S, p.max_log), np.int32)
+        lcid = np.zeros((S, p.max_log), np.int32)
+        lcm = np.zeros((S, p.max_log), np.int32)
+        for i in members:
+            lt[i, 0] = 1
+            lc[i, 0] = CMD_NEW
+            lcid[i, 0] = 1
+            lcm[i, 0] = mask
+        vec[0, lay.sl("log_term")] = lt.reshape(-1)
+        vec[0, lay.sl("log_cmd")] = lc.reshape(-1)
+        vec[0, lay.sl("log_cid")] = lcid.reshape(-1)
+        vec[0, lay.sl("log_members")] = lcm.reshape(-1)
+        vec[0, lay.sl("log_len")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("commitIndex")] = [1 if i in members else 0 for i in range(S)]
+        for k in range(self.n_words):
+            vec[0, lay.sl(f"msg_w{k}")] = int(EMPTY)
+        vec[0, lay.sl("acked")] = ACK_NIL
+        return vec
+
+    # ---------------- invariants ----------------
+
+    def _inv_no_log_divergence(self, states):
+        """NoLogDivergence — :1066-1074."""
+        lay, L = self.layout, self.p.max_log
+        ci = lay.get(states, "commitIndex")
+        mci = jnp.minimum(ci[:, :, None], ci[:, None, :])
+        lanes = jnp.arange(1, L + 1, dtype=jnp.int32)
+        in_common = lanes[None, None, None, :] <= mci[..., None]
+        eq = jnp.ones(in_common.shape, dtype=bool)
+        for n in ENTRY_FIELDS:
+            f = lay.get(states, f"log_{n}")
+            eq &= f[:, :, None, :] == f[:, None, :, :]
+        return jnp.all(~in_common | eq, axis=(1, 2, 3))
+
+    def _inv_max_one_reconfig(self, states):
+        """MaxOneReconfigurationAtATime — :1080-1101: same-type config
+        commands need the opposite type strictly between them."""
+        lay, L = self.layout, self.p.max_log
+        cmd = lay.get(states, "log_cmd")  # [B,S,L]
+        ll = lay.get(states, "log_len")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_log = lanes[None, None, :] < ll[:, :, None]
+        ok = jnp.ones(cmd.shape[:2], dtype=bool)
+        for c, other in ((CMD_OLDNEW, CMD_NEW), (CMD_NEW, CMD_OLDNEW)):
+            is_c = in_log & (cmd == c)
+            is_o = in_log & (cmd == other)
+            # pair [.., k1, k2] with k1 < k2 both command c
+            pair = is_c[..., :, None] & is_c[..., None, :]
+            k1 = lanes[:, None]
+            k2 = lanes[None, :]
+            upper = k2 > k1
+            # between[k1, k2]: exists opposite-type at k with k1 < k < k2
+            between = (lanes[None, None, :] > k1[..., None]) & (
+                lanes[None, None, :] < k2[..., None]
+            )  # [L, L, L]
+            has_between = jnp.any(
+                between[None, None] & is_o[:, :, None, None, :], axis=-1
+            )  # [B,S,L,L]
+            bad = pair & upper[None, None] & ~has_between
+            ok &= ~jnp.any(bad, axis=(2, 3))
+        return jnp.all(ok, axis=1)
+
+    def _inv_leader_has_acked(self, states):
+        """LeaderHasAllAckedValues — :1109-1125."""
+        lay, V = self.layout, self.p.n_values
+        ct = lay.get(states, "currentTerm")
+        st = lay.get(states, "state")
+        lv = lay.get(states, "log_val")
+        cmd = lay.get(states, "log_cmd")
+        acked = lay.get(states, "acked")
+        not_stale = jnp.all(ct[:, :, None] >= ct[:, None, :], axis=2)
+        is_lead = (st == LEADER) & not_stale
+        vals = jnp.arange(1, V + 1, dtype=jnp.int32)
+        lv_app = jnp.where(cmd == CMD_APPEND, lv, 0)
+        has_v = jnp.any(lv_app[:, :, None, :] == vals[None, None, :, None], axis=3)
+        bad = jnp.any(
+            (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v,
+            axis=(1, 2),
+        )
+        return ~bad
+
+    def _inv_committed_majority(self, states):
+        """CommittedEntriesReachMajority — :1129-1140."""
+        lay, S, L = self.layout, self.p.n_servers, self.p.max_log
+        st = lay.get(states, "state")
+        ci = lay.get(states, "commitIndex")
+        ll = lay.get(states, "log_len")
+        members = lay.get(states, "config_members")
+        lead = (st == LEADER) & (ci > 0)
+        pos = jnp.clip(ci - 1, 0, L - 1)
+        match = jnp.ones(st.shape[:1] + (S, S), dtype=bool)
+        for n in ENTRY_FIELDS:
+            f = lay.get(states, f"log_{n}")
+            fi = jnp.take_along_axis(f, pos[:, :, None], axis=2)[:, :, 0]
+            fj = jnp.take_along_axis(
+                jnp.broadcast_to(f[:, None, :, :], f.shape[:1] + (S,) + f.shape[1:]),
+                jnp.broadcast_to(pos[:, :, None, None], pos.shape + (S, 1)),
+                axis=3,
+            )[..., 0]
+            match &= fj == fi[..., None]
+        match &= ll[:, None, :] >= ci[:, :, None]
+        ks = jnp.arange(S, dtype=jnp.int32)
+        member_j = ((members[:, :, None] >> ks[None, None, :]) & 1) > 0
+        agree = match & member_j
+        n_members = jnp.sum(member_j, axis=2)
+        eye = jnp.eye(S, dtype=bool)
+        self_in = jnp.any(agree & eye[None, :, :], axis=2)
+        enough = (jnp.sum(agree, axis=2) >= (n_members // 2 + 1)) & self_in
+        ok_exists = jnp.any(lead & enough, axis=1)
+        return ~jnp.any(lead, axis=1) | ok_exists
+
+    # ---------------- host-side decode/encode ----------------
+
+    def _fs(self, mask) -> frozenset:
+        return frozenset(
+            j for j in range(self.p.n_servers) if (int(mask) >> j) & 1
+        )
+
+    def _decode_entry(self, term, cmd, val, cid, old, new, members):
+        cmd_name = CMD_NAMES[int(cmd)]
+        if cmd_name == "AppendCommand":
+            return (cmd_name, int(term), int(val) - 1)
+        if cmd_name == "NewConfigCommand":
+            return (cmd_name, int(term), (int(cid), self._fs(members)))
+        return (
+            cmd_name,
+            int(term),
+            (int(cid), self._fs(old), self._fs(new), self._fs(members)),
+        )
+
+    def _encode_entry(self, entry):
+        cmd_name, term, val = entry
+        inv_cmd = {v: k for k, v in CMD_NAMES.items()}
+        cmd = inv_cmd[cmd_name]
+        mk = lambda fs: sum(1 << j for j in fs)
+        if cmd == CMD_APPEND:
+            return dict(term=term, cmd=cmd, val=val + 1, cid=0, old=0, new=0, members=0)
+        if cmd == CMD_NEW:
+            return dict(
+                term=term, cmd=cmd, val=0, cid=val[0], old=0, new=0,
+                members=mk(val[1]),
+            )
+        return dict(
+            term=term, cmd=cmd, val=0, cid=val[0], old=mk(val[1]),
+            new=mk(val[2]), members=mk(val[3]),
+        )
+
+    def decode(self, vec: np.ndarray) -> dict:
+        lay, p = self.layout, self.p
+        g = lambda n: np.asarray(vec[lay.sl(n)])
+        S, L = p.n_servers, p.max_log
+        rows = {n: g(f"log_{n}").reshape(S, L) for n in ENTRY_FIELDS}
+        ll = g("log_len")
+        log = tuple(
+            tuple(
+                self._decode_entry(*(rows[n][i, k] for n in ENTRY_FIELDS))
+                for k in range(int(ll[i]))
+            )
+            for i in range(S)
+        )
+        vg = g("votesGranted")
+        votes = tuple(
+            frozenset(j for j in range(S) if (int(vg[i]) >> j) & 1) for i in range(S)
+        )
+        pr = g("pendingResponse")
+        pending = tuple(
+            tuple(bool((int(pr[i]) >> j) & 1) for j in range(S)) for i in range(S)
+        )
+        config = tuple(
+            (
+                int(g("config_id")[i]),
+                bool(g("config_joint")[i]),
+                self._fs(g("config_members")[i]),
+                self._fs(g("config_old")[i]),
+                self._fs(g("config_new")[i]),
+                bool(g("config_committed")[i]),
+            )
+            for i in range(S)
+        )
+        msgs = {}
+        word_arrs = [g(f"msg_w{k}") for k in range(self.n_words)]
+        cnt = g("msg_cnt")
+        for k in range(p.msg_slots):
+            if int(word_arrs[0][k]) == int(EMPTY):
+                continue
+            key = tuple(int(w[k]) for w in word_arrs)
+            msgs[self.decode_msg(key)] = int(cnt[k])
+        return {
+            "config": config,
+            "currentTerm": tuple(int(x) for x in g("currentTerm")),
+            "state": tuple(int(x) for x in g("state")),
+            "votedFor": tuple(int(x) - 1 if x > 0 else None for x in g("votedFor")),
+            "votesGranted": votes,
+            "nextIndex": tuple(
+                tuple(int(x) for x in row) for row in g("nextIndex").reshape(S, S)
+            ),
+            "matchIndex": tuple(
+                tuple(int(x) for x in row) for row in g("matchIndex").reshape(S, S)
+            ),
+            "pendingResponse": pending,
+            "log": log,
+            "commitIndex": tuple(int(x) for x in g("commitIndex")),
+            "messages": frozenset(msgs.items()),
+            "acked": tuple(
+                {ACK_NIL: None, ACK_FALSE: False, ACK_TRUE: True}[int(x)]
+                for x in g("acked")
+            ),
+            "electionCtr": int(vec[lay.fields["electionCtr"].offset]),
+            "restartCtr": int(vec[lay.fields["restartCtr"].offset]),
+            "reconfigCtr": int(vec[lay.fields["reconfigCtr"].offset]),
+            "valueCtr": tuple(int(x) for x in g("valueCtr")),
+        }
+
+    def decode_msg(self, key: tuple) -> tuple:
+        u = self.packer.unpack_all(key)
+        mtype = int(u["mtype"])
+        rec = {
+            "mtype": MTYPE_NAMES[mtype],
+            "mterm": int(u["mterm"]),
+            "msource": int(u["msource"]),
+            "mdest": int(u["mdest"]),
+        }
+        if mtype == RVREQ:
+            rec["mlastLogTerm"] = int(u["mlastLogTerm"])
+            rec["mlastLogIndex"] = int(u["mlastLogIndex"])
+        elif mtype == RVRESP:
+            rec["mvoteGranted"] = bool(u["mvoteGranted"])
+        elif mtype == AEREQ:
+            rec["mprevLogIndex"] = int(u["mprevLogIndex"])
+            rec["mprevLogTerm"] = int(u["mprevLogTerm"])
+            rec["mentries"] = (
+                (self._decode_entry(*(u[f"e_{n}"] for n in ENTRY_FIELDS)),)
+                if u["nentries"]
+                else ()
+            )
+            rec["mcommitIndex"] = int(u["mcommitIndex"])
+        elif mtype == AERESP:
+            rec["mresult"] = RC_NAMES[int(u["mresult"])]
+            rec["mmatchIndex"] = int(u["mmatchIndex"])
+        elif mtype == SNAPREQ:
+            ll = int(u["mloglen"])
+            rec["mlog"] = tuple(
+                self._decode_entry(*(u[f"l{k}_{n}"] for n in ENTRY_FIELDS))
+                for k in range(ll)
+            )
+            rec["mcommitIndex"] = int(u["mcommitIndex"])
+            rec["mmembers"] = self._fs(u["mmembers"])
+        elif mtype == SNAPRESP:
+            rec["msuccess"] = bool(u["msuccess"])
+            rec["mmatchIndex"] = int(u["mmatchIndex"])
+        return tuple(sorted(rec.items()))
+
+    def encode_msg(self, rec: tuple) -> tuple:
+        d = dict(rec)
+        mtype = {v: k for k, v in MTYPE_NAMES.items()}[d["mtype"]]
+        kw = dict(
+            mtype=mtype, mterm=d["mterm"], msource=d["msource"], mdest=d["mdest"]
+        )
+        if mtype == RVREQ:
+            kw.update(
+                mlastLogTerm=d["mlastLogTerm"], mlastLogIndex=d["mlastLogIndex"]
+            )
+        elif mtype == RVRESP:
+            kw.update(mvoteGranted=int(d["mvoteGranted"]))
+        elif mtype == AEREQ:
+            kw.update(
+                mprevLogIndex=d["mprevLogIndex"],
+                mprevLogTerm=d["mprevLogTerm"],
+                nentries=len(d["mentries"]),
+                mcommitIndex=d["mcommitIndex"],
+            )
+            if d["mentries"]:
+                kw.update(
+                    {f"e_{n}": v for n, v in self._encode_entry(d["mentries"][0]).items()}
+                )
+        elif mtype == AERESP:
+            inv_rc = {v: k for k, v in RC_NAMES.items()}
+            kw.update(mresult=inv_rc[d["mresult"]], mmatchIndex=d["mmatchIndex"])
+        elif mtype == SNAPREQ:
+            kw.update(
+                mloglen=len(d["mlog"]),
+                mcommitIndex=d["mcommitIndex"],
+                mmembers=sum(1 << j for j in d["mmembers"]),
+            )
+            for k, e in enumerate(d["mlog"]):
+                kw.update({f"l{k}_{n}": v for n, v in self._encode_entry(e).items()})
+        elif mtype == SNAPRESP:
+            kw.update(msuccess=int(d["msuccess"]), mmatchIndex=d["mmatchIndex"])
+        return self.packer.pack(**kw)
+
+    def encode(self, st: dict) -> np.ndarray:
+        lay, p = self.layout, self.p
+        S, L = p.n_servers, p.max_log
+        mk = lambda fs: sum(1 << j for j in fs)
+        vec = lay.zeros(())
+        vec[lay.sl("config_id")] = [c[0] for c in st["config"]]
+        vec[lay.sl("config_joint")] = [int(c[1]) for c in st["config"]]
+        vec[lay.sl("config_members")] = [mk(c[2]) for c in st["config"]]
+        vec[lay.sl("config_old")] = [mk(c[3]) for c in st["config"]]
+        vec[lay.sl("config_new")] = [mk(c[4]) for c in st["config"]]
+        vec[lay.sl("config_committed")] = [int(c[5]) for c in st["config"]]
+        vec[lay.sl("currentTerm")] = st["currentTerm"]
+        vec[lay.sl("state")] = st["state"]
+        vec[lay.sl("votedFor")] = [0 if v is None else v + 1 for v in st["votedFor"]]
+        vec[lay.sl("votesGranted")] = [mk(vs) for vs in st["votesGranted"]]
+        rows = {n: np.zeros((S, L), np.int32) for n in ENTRY_FIELDS}
+        for i, lg in enumerate(st["log"]):
+            for k, e in enumerate(lg):
+                for n, v in self._encode_entry(e).items():
+                    rows[n][i, k] = v
+        for n in rows:
+            vec[lay.sl(f"log_{n}")] = rows[n].reshape(-1)
+        vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
+        vec[lay.sl("commitIndex")] = st["commitIndex"]
+        vec[lay.sl("nextIndex")] = np.asarray(st["nextIndex"]).reshape(-1)
+        vec[lay.sl("matchIndex")] = np.asarray(st["matchIndex"]).reshape(-1)
+        vec[lay.sl("pendingResponse")] = [
+            sum(1 << j for j, b in enumerate(row) if b)
+            for row in st["pendingResponse"]
+        ]
+        keys = sorted((self.encode_msg(rec), cnt) for rec, cnt in st["messages"])
+        if len(keys) > p.msg_slots:
+            raise OverflowError("message bag exceeds msg_slots")
+        word_arrs = [
+            np.full(p.msg_slots, int(EMPTY), np.int32) for _ in range(self.n_words)
+        ]
+        cn = np.zeros(p.msg_slots, np.int32)
+        for k, (key, c) in enumerate(keys):
+            for w, arr in zip(key, word_arrs):
+                arr[k] = w
+            cn[k] = c
+        for k, arr in enumerate(word_arrs):
+            vec[lay.sl(f"msg_w{k}")] = arr
+        vec[lay.sl("msg_cnt")] = cn
+        vec[lay.sl("acked")] = [
+            {None: ACK_NIL, False: ACK_FALSE, True: ACK_TRUE}[a] for a in st["acked"]
+        ]
+        vec[lay.fields["electionCtr"].offset] = st["electionCtr"]
+        vec[lay.fields["restartCtr"].offset] = st["restartCtr"]
+        vec[lay.fields["reconfigCtr"].offset] = st["reconfigCtr"]
+        vec[lay.sl("valueCtr")] = st["valueCtr"]
+        return vec
+
+
+@lru_cache(maxsize=None)
+def _cached_model(params: "JointRaftParams") -> "JointRaftModel":
+    return JointRaftModel(params)

@@ -197,6 +197,83 @@ def build_kraft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     )
 
 
+def build_reconfig_add_remove(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """standard-raft/RaftWithReconfigAddRemove.tla + its cfg. The reference
+    cfg omits the required ``MaxClusterSize`` constant
+    (RaftWithReconfigAddRemove.tla:88 vs the cfg; SURVEY.md §2.2) — strict
+    mode raises, lenient mode repairs it to |Server| (the physical bound)
+    and records a diagnostic."""
+    from .reconfig_raft import ReconfigRaftModel, ReconfigRaftParams
+
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    if "MaxClusterSize" not in cfg.constants:
+        diag = (
+            f"{cfg.path}: required constant MaxClusterSize "
+            "(RaftWithReconfigAddRemove.tla:88) is missing from the cfg; "
+            f"lenient mode repairs this by defaulting it to |Server| = {len(servers)}"
+        )
+        if not cfg.lenient:
+            raise CfgError(diag)
+        cfg.diagnostics.append(diag)
+        cfg.constants["MaxClusterSize"] = len(servers)
+    params = ReconfigRaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        init_cluster_size=_require_int(cfg, "InitClusterSize"),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        max_values_per_term=_require_int(cfg, "MaxValuesPerTerm"),
+        max_add_reconfigs=_require_int(cfg, "MaxAddReconfigs"),
+        max_remove_reconfigs=_require_int(cfg, "MaxRemoveReconfigs"),
+        min_cluster_size=_require_int(cfg, "MinClusterSize"),
+        max_cluster_size=_require_int(cfg, "MaxClusterSize"),
+        include_thesis_bug=_require_bool(cfg, "IncludeThesisBug"),
+        # snapshot records embed whole logs and AppendEntries pile up per
+        # (term, prev, entry) combination: needs the most headroom so far
+        msg_slots=msg_slots if msg_slots is not None else 112,
+    )
+    model = ReconfigRaftModel(params, server_names=servers, value_names=values)
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
+def build_reconfig_joint(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """standard-raft/RaftWithReconfigJointConsensus.tla + its cfg: joint
+    consensus reconfiguration with dual quorums and the ReconfigType knob
+    (RaftWithReconfigJointConsensus.tla:79-80)."""
+    from .joint_raft import JointRaftModel, JointRaftParams
+
+    servers = cfg.server_like("Server")
+    values = cfg.server_like("Value")
+    params = JointRaftParams(
+        n_servers=len(servers),
+        n_values=len(values),
+        init_cluster_size=_require_int(cfg, "InitClusterSize"),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        max_reconfigs=_require_int(cfg, "MaxReconfigs"),
+        max_values_per_term=_require_int(cfg, "MaxValuesPerTerm"),
+        reconfig_type=_require_int(cfg, "ReconfigType"),
+        msg_slots=msg_slots if msg_slots is not None else 112,
+    )
+    model = JointRaftModel(params, server_names=servers, value_names=values)
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=servers,
+        value_names=values,
+    )
+
+
 BUILDERS = {
     "Raft": build_raft,
     "FlexibleRaft": build_flexible_raft,
@@ -204,6 +281,8 @@ BUILDERS = {
     "PullRaft": build_pull_raft,
     "PullRaftVariant2": build_pull_raft_v2,
     "KRaft": build_kraft,
+    "RaftWithReconfigAddRemove": build_reconfig_add_remove,
+    "RaftWithReconfigJointConsensus": build_reconfig_joint,
 }
 
 
@@ -221,6 +300,27 @@ def oracle_for_setup(setup: CheckSetup):
         from ..oracle.kraft_oracle import KRaftOracle
 
         return KRaftOracle(p.n_servers, p.n_values, p.max_elections, p.max_restarts)
+    from .reconfig_raft import ReconfigRaftParams
+
+    if isinstance(p, ReconfigRaftParams):
+        from ..oracle.reconfig_oracle import ReconfigRaftOracle
+
+        return ReconfigRaftOracle(
+            p.n_servers, p.n_values, p.init_cluster_size, p.max_elections,
+            p.max_restarts, p.max_values_per_term, p.max_add_reconfigs,
+            p.max_remove_reconfigs, p.min_cluster_size, p.max_cluster_size,
+            include_thesis_bug=p.include_thesis_bug,
+        )
+    from .joint_raft import JointRaftParams
+
+    if isinstance(p, JointRaftParams):
+        from ..oracle.joint_oracle import JointRaftOracle
+
+        return JointRaftOracle(
+            p.n_servers, p.n_values, p.init_cluster_size, p.max_elections,
+            p.max_restarts, p.max_reconfigs, p.max_values_per_term,
+            p.reconfig_type,
+        )
     from ..oracle.raft_oracle import oracle_for
 
     return oracle_for(p)

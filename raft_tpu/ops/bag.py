@@ -8,10 +8,12 @@ TLA+ semantics being reproduced (reference ``standard-raft/Raft.tla``):
     freed: the slot table grows monotonically within a behavior and
     count-0 slots are genuine state that must fingerprint.
 
-Encoding: three int32 lanes per bag — sorted key words ``hi``/``lo``
-(30 bits each, see ops/packing.py) plus ``cnt``. Unused slots hold
-(EMPTY, EMPTY, 0) and sort last; keys are unique, so the sorted triple is
-a canonical form and bag equality is array equality.
+Encoding: N key words + a count lane per slot (see ops/packing.py).
+``words`` is a list of [M] int32 arrays in lexicographic sort order;
+unused slots hold (EMPTY, ..., 0) and sort last; keys are unique, so the
+sorted slot table is a canonical form and bag equality is array equality.
+The 2-word (hi, lo) kernels used by the BitPacker models are thin
+wrappers over the N-word ones.
 """
 
 from __future__ import annotations
@@ -22,10 +24,45 @@ from jax import lax
 from .packing import EMPTY
 
 
+def wide_bag_sort(words, cnt):
+    """Canonicalize: sort slots lexicographically by the key words."""
+    out = lax.sort((*words, cnt), num_keys=len(words))
+    return list(out[:-1]), out[-1]
+
+
+def wide_bag_put(words, cnt, key):
+    """Add one delivery of the key tuple — TLA+ ``_SendNoRestriction``
+    (``Raft.tla:129-132``): increment if the record is in the domain, else
+    insert with count 1.
+
+    Returns (words, cnt, existed, overflow). ``existed`` lets callers
+    implement ``_SendOnce`` (valid iff not existed). ``overflow`` is True
+    when an insert was needed but no slot was free — the driver must abort
+    and re-run with more slots (never silently dropped).
+    """
+    eq = jnp.ones_like(words[0], dtype=bool)
+    for w, k in zip(words, key):
+        eq &= w == k
+    existed = eq.any()
+    cnt_inc = cnt + eq.astype(cnt.dtype)
+
+    is_empty = words[0] == EMPTY
+    slot = jnp.argmax(is_empty)  # empties are sorted last; any empty works
+    have_empty = is_empty.any()
+    ins = [w.at[slot].set(k) for w, k in zip(words, key)]
+    cnt_ins = cnt.at[slot].set(jnp.int32(1))
+
+    out = [jnp.where(existed, w, wi) for w, wi in zip(words, ins)]
+    cnt2 = jnp.where(existed, cnt_inc, cnt_ins)
+    overflow = (~existed) & (~have_empty)
+    out, cnt2 = wide_bag_sort(out, cnt2)
+    return out, cnt2, existed, overflow
+
+
 def bag_sort(hi, lo, cnt):
-    """Canonicalize: sort slots lexicographically by (hi, lo); empties last."""
-    hi, lo, cnt = lax.sort((hi, lo, cnt), num_keys=2)
-    return hi, lo, cnt
+    """2-word canonicalization: sort by (hi, lo); empties last."""
+    words, cnt = wide_bag_sort([hi, lo], cnt)
+    return words[0], words[1], cnt
 
 
 def bag_count(hi, lo, cnt, khi, klo):
@@ -35,32 +72,9 @@ def bag_count(hi, lo, cnt, khi, klo):
 
 
 def bag_put(hi, lo, cnt, khi, klo):
-    """Add one delivery of key (khi, klo) — TLA+ ``_SendNoRestriction``
-    (``Raft.tla:129-132``): increment if the record is in the domain, else
-    insert with count 1.
-
-    Returns (hi, lo, cnt, existed, overflow). ``existed`` lets callers
-    implement ``_SendOnce`` (valid iff not existed). ``overflow`` is True
-    when an insert was needed but no slot was free — the driver must abort
-    and re-run with more slots (never silently dropped).
-    """
-    eq = (hi == khi) & (lo == klo)
-    existed = eq.any()
-    cnt_inc = cnt + eq.astype(cnt.dtype)
-
-    is_empty = hi == EMPTY
-    slot = jnp.argmax(is_empty)  # empties are sorted last; any empty works
-    have_empty = is_empty.any()
-    hi_ins = hi.at[slot].set(khi)
-    lo_ins = lo.at[slot].set(klo)
-    cnt_ins = cnt.at[slot].set(jnp.int32(1))
-
-    hi2 = jnp.where(existed, hi, hi_ins)
-    lo2 = jnp.where(existed, lo, lo_ins)
-    cnt2 = jnp.where(existed, cnt_inc, cnt_ins)
-    overflow = (~existed) & (~have_empty)
-    hi2, lo2, cnt2 = bag_sort(hi2, lo2, cnt2)
-    return hi2, lo2, cnt2, existed, overflow
+    """2-word ``_SendNoRestriction``; see wide_bag_put."""
+    words, cnt2, existed, overflow = wide_bag_put([hi, lo], cnt, (khi, klo))
+    return words[0], words[1], cnt2, existed, overflow
 
 
 def bag_discard_at(cnt, slot):

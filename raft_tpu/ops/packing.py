@@ -1,4 +1,4 @@
-"""Bit-packing of TLA+ message records into two non-negative int32 words.
+"""Bit-packing of TLA+ message records into N non-negative int32 words.
 
 The reference specs model the network as a bag: a function from message
 records to delivery counts (``Raft.tla:55-58``). Record equality is
@@ -6,10 +6,13 @@ full-field equality, so a record packs losslessly into a fixed-width bit
 string; bag membership / lookup then becomes integer comparison, and bag
 canonicalization becomes an integer sort.
 
-We pack into two 30-bit words (``hi``, ``lo``) kept in int32 lanes of the
-state vector. 30 bits per word keeps every word non-negative, so
-lexicographic (hi, lo) sorting with signed comparisons gives the correct
-unsigned order, and the EMPTY sentinel (1 << 30) sorts after all real keys.
+Words are 30-bit so every word stays non-negative in an int32 lane:
+lexicographic sorting with signed comparisons then gives the correct
+unsigned order, and the EMPTY sentinel (1 << 30) sorts after all real
+keys. ``WidePacker`` is the general N-word form (needed for records too
+big for 60 bits, e.g. the reconfig specs' snapshot messages that embed a
+whole log, ``RaftWithReconfigAddRemove.tla:870-876``); ``BitPacker`` is
+the 2-word case behind the original (hi, lo) API.
 """
 
 from __future__ import annotations
@@ -20,15 +23,17 @@ WORD_BITS = 30
 EMPTY = np.int32(1 << WORD_BITS)  # sentinel word for unused message slots
 
 
-class BitPacker:
-    """Packs a fixed schema of small unsigned fields into (hi, lo) words.
+class WidePacker:
+    """Packs a fixed schema of small unsigned fields into an n_words tuple.
 
-    Fields are laid out low-bit-first in declaration order; a field that
-    would straddle the 30-bit word boundary is bumped to the next word.
-    Works on numpy arrays, jax arrays and plain ints (pure arithmetic).
+    Fields are laid out low-bit-first in declaration order starting in
+    word 0; a field that would straddle a 30-bit word boundary is bumped
+    to the next word. Works on numpy arrays, jax arrays and plain ints
+    (pure arithmetic). Unused bag slots hold EMPTY in every word.
     """
 
-    def __init__(self, fields: list[tuple[str, int]]):
+    def __init__(self, fields: list[tuple[str, int]], n_words: int):
+        self.n_words = n_words
         self.fields: dict[str, tuple[int, int]] = {}  # name -> (offset, bits)
         off = 0
         for name, bits in fields:
@@ -37,8 +42,10 @@ class BitPacker:
             word, in_word = divmod(off, WORD_BITS)
             if in_word + bits > WORD_BITS:  # would straddle: bump to next word
                 off = (word + 1) * WORD_BITS
-            if off + bits > 2 * WORD_BITS:
-                raise ValueError("message schema exceeds 60 bits")
+            if off + bits > n_words * WORD_BITS:
+                raise ValueError(
+                    f"message schema exceeds {n_words * WORD_BITS} bits"
+                )
             self.fields[name] = (off, bits)
             off += bits
         self.total_bits = off
@@ -46,13 +53,12 @@ class BitPacker:
     def field_names(self) -> list[str]:
         return list(self.fields)
 
-    def pack(self, **vals):
-        """Pack named field values into (hi, lo). Missing fields are 0."""
+    def pack(self, **vals) -> tuple:
+        """Pack named field values into an n_words tuple (missing = 0)."""
         unknown = set(vals) - set(self.fields)
         if unknown:
             raise KeyError(f"unknown message fields {unknown}")
-        hi = 0
-        lo = 0
+        words = [0] * self.n_words
         for name, v in vals.items():
             off, bits = self.fields[name]
             if isinstance(v, (int, np.integer)):
@@ -60,33 +66,55 @@ class BitPacker:
                     raise ValueError(f"{name}={v} out of range for {bits} bits")
                 v = int(v)
             word, in_word = divmod(off, WORD_BITS)
-            placed = v << in_word
-            if word == 0:
-                lo = lo + placed
-            else:
-                hi = hi + placed
-        return hi, lo
+            words[word] = words[word] + (v << in_word)
+        return tuple(words)
 
-    def unpack(self, hi, lo, name: str):
-        """Extract one field from (hi, lo); works on arrays or ints."""
+    def unpack(self, words, name: str):
         off, bits = self.fields[name]
         word, in_word = divmod(off, WORD_BITS)
-        src = hi if word == 1 else lo
-        return (src >> in_word) & ((1 << bits) - 1)
+        return (words[word] >> in_word) & ((1 << bits) - 1)
 
-    def unpack_all(self, hi, lo) -> dict:
-        return {name: self.unpack(hi, lo, name) for name in self.fields}
+    def unpack_all(self, words) -> dict:
+        return {name: self.unpack(words, name) for name in self.fields}
 
-    def replace(self, hi, lo, name: str, value):
-        """Return (hi, lo) with one field replaced; array-friendly."""
+    def replace(self, words, name: str, value) -> tuple:
         off, bits = self.fields[name]
         word, in_word = divmod(off, WORD_BITS)
         mask = ((1 << bits) - 1) << in_word
-        if word == 1:
-            hi = (hi & ~mask) | (value << in_word)
-        else:
-            lo = (lo & ~mask) | (value << in_word)
+        out = list(words)
+        out[word] = (out[word] & ~mask) | (value << in_word)
+        return tuple(out)
+
+
+class BitPacker:
+    """Two-word packer behind the original (hi, lo) API.
+
+    Delegates to a ``WidePacker(fields, 2)``: the low word (offset-0
+    fields) is ``lo`` and the second word is ``hi``, preserving the
+    historical (hi, lo) lexicographic sort order of the 2-word bags.
+    """
+
+    def __init__(self, fields: list[tuple[str, int]]):
+        self._w = WidePacker(fields, 2)
+        self.fields = self._w.fields
+        self.total_bits = self._w.total_bits
+
+    def field_names(self) -> list[str]:
+        return list(self.fields)
+
+    def pack(self, **vals):
+        lo, hi = self._w.pack(**vals)
         return hi, lo
+
+    def unpack(self, hi, lo, name: str):
+        return self._w.unpack((lo, hi), name)
+
+    def unpack_all(self, hi, lo) -> dict:
+        return self._w.unpack_all((lo, hi))
+
+    def replace(self, hi, lo, name: str, value):
+        lo2, hi2 = self._w.replace((lo, hi), name, value)
+        return hi2, lo2
 
 
 def bits_for(max_value: int) -> int:

@@ -14,10 +14,19 @@ Reproduces TLC's distinct-state semantics for cfgs that declare
 
 A permutation sigma acts on the packed view as (see models/base.py kinds):
 row gathers for server-indexed axes, value remaps for server-valued fields
-and bitmasks, msource/mdest remap inside packed message keys followed by a
+and bitmasks, and field remaps inside packed message keys followed by a
 bag re-sort. The row gathers compose into ONE precomputed lane-gather per
 permutation, so the device work per permutation is a gather + two tiny
 fixups + an M-lane sort + hash.
+
+Message keys may be 2-word (BitPacker: msg_hi/msg_lo/msg_cnt kinds) or
+N-word (WidePacker: msg_word kinds, declared in word order). A model
+declares which packed fields transform under sigma either via
+``msg_server_fields`` / ``msg_server_nil_fields`` (plain / nil-valued
+server ids) or a full ``msg_perm_spec`` of (field, kind) pairs with kind
+in {"server", "server_nil", "server_bitmask"} — the bitmask kind covers
+member sets inside reconfig-spec messages
+(``RaftWithReconfigAddRemove.tla:874``).
 """
 
 from __future__ import annotations
@@ -31,7 +40,7 @@ import numpy as np
 from jax import lax
 
 from .hashing import hash_lanes
-from .packing import EMPTY, BitPacker
+from .packing import EMPTY, BitPacker, WidePacker
 from ..models.base import Layout
 
 
@@ -47,15 +56,17 @@ class Canonicalizer:
                 model, "msg_server_fields", ("msource", "mdest")
             ),
             msg_server_nil_fields=getattr(model, "msg_server_nil_fields", ()),
+            msg_perm_spec=getattr(model, "msg_perm_spec", None),
             symmetry=symmetry,
         )
 
     def __init__(
         self,
         layout: Layout,
-        packer: BitPacker,
+        packer,
         msg_server_fields: tuple[str, ...] = ("msource", "mdest"),
         msg_server_nil_fields: tuple[str, ...] = (),
+        msg_perm_spec: tuple[tuple[str, str], ...] | None = None,
         symmetry: bool = True,
     ):
         S = layout.n_servers
@@ -63,10 +74,15 @@ class Canonicalizer:
         assert VL is not None
         self.layout = layout
         self.packer = packer
-        self.msg_server_fields = msg_server_fields
-        # Nil-valued server fields inside packed records (0 = Nil, i+1 = i),
-        # e.g. KRaft's mleader (KRaft.tla:500,644): 0 stays, v -> sigma(v-1)+1.
-        self.msg_server_nil_fields = msg_server_nil_fields
+        # Unified remap spec: (packed field, kind) with kind one of
+        #   server          plain server index (msource/mdest)
+        #   server_nil      0 = Nil, i+1 = server i (KRaft mleader)
+        #   server_bitmask  member set as a bitmask over servers
+        if msg_perm_spec is None:
+            msg_perm_spec = tuple(
+                (f, "server") for f in msg_server_fields
+            ) + tuple((f, "server_nil") for f in msg_server_nil_fields)
+        self.msg_perm_spec = msg_perm_spec
 
         if symmetry:
             perms = np.array(list(itertools.permutations(range(S))), dtype=np.int32)
@@ -79,7 +95,14 @@ class Canonicalizer:
         gidx = np.tile(np.arange(VL, dtype=np.int32), (P, 1))
         val_lanes: list[int] = []
         bm_lanes: list[int] = []
-        msg_sl: dict[str, slice] = {}
+        # key-word slices, ordered by sort significance: (hi, lo) for the
+        # 2-word BitPacker bags (collected by kind, so layout declaration
+        # order cannot silently flip them), msg_word declaration order for
+        # the N-word WidePacker bags (word 0 = sort-major by contract)
+        hi_sl: slice | None = None
+        lo_sl: slice | None = None
+        wide_sls: list[slice] = []
+        msg_cnt_sl: slice | None = None
         for f in layout.fields.values():
             if f.offset >= VL:
                 continue  # aux: not fingerprinted
@@ -95,8 +118,22 @@ class Canonicalizer:
             elif f.kind == "per_server_pair":
                 src = f.offset + inv[:, :, None] * S + inv[:, None, :]  # [P,S,S]
                 gidx[:, f.offset : f.offset + f.size] = src.reshape(P, -1)
-            elif f.kind in ("msg_hi", "msg_lo", "msg_cnt"):
-                msg_sl[f.kind] = layout.sl(f.name)
+            elif f.kind == "msg_hi":
+                hi_sl = layout.sl(f.name)
+            elif f.kind == "msg_lo":
+                lo_sl = layout.sl(f.name)
+            elif f.kind == "msg_word":
+                wide_sls.append(layout.sl(f.name))
+            elif f.kind == "msg_cnt":
+                msg_cnt_sl = layout.sl(f.name)
+        if hi_sl is not None or lo_sl is not None:
+            assert hi_sl is not None and lo_sl is not None and not wide_sls
+            msg_word_sls = [hi_sl, lo_sl]
+        else:
+            msg_word_sls = wide_sls
+        if msg_word_sls:
+            n_expected = 2 if hi_sl is not None else getattr(packer, "n_words", None)
+            assert n_expected is None or len(msg_word_sls) == n_expected
 
         # value remap: 0 stays Nil, v in 1..S maps to sigma[v-1]+1
         valmap = np.zeros((P, S + 1), dtype=np.int32)
@@ -110,8 +147,21 @@ class Canonicalizer:
         self._pow2sig = jnp.asarray(pow2sig)
         self._val_lanes = np.array(sorted(val_lanes), dtype=np.int32)
         self._bm_lanes = np.array(sorted(bm_lanes), dtype=np.int32)
-        self._msg_sl = msg_sl
+        self._msg_word_sls = msg_word_sls
+        self._msg_cnt_sl = msg_cnt_sl
         self.fingerprints = jax.jit(self._fingerprints)
+
+    # packer adapters: BitPacker works on (hi, lo), WidePacker on tuples
+    def _unpack_key(self, words, name):
+        if isinstance(self.packer, WidePacker):
+            return self.packer.unpack(words, name)
+        return self.packer.unpack(words[0], words[1], name)
+
+    def _replace_key(self, words, name, value):
+        if isinstance(self.packer, WidePacker):
+            return list(self.packer.replace(words, name, value))
+        hi, lo = self.packer.replace(words[0], words[1], name, value)
+        return [hi, lo]
 
     def _one_perm(self, view, gi, valmap, pow2, sigma):
         """Apply one permutation to [B, VL] views and hash."""
@@ -124,27 +174,30 @@ class Canonicalizer:
             x = v[:, self._bm_lanes]
             bits = (x[..., None] >> jnp.arange(S, dtype=jnp.int32)) & 1
             v = v.at[:, self._bm_lanes].set(jnp.sum(bits * pow2, axis=-1).astype(jnp.int32))
-        if self._msg_sl:
-            hi = v[:, self._msg_sl["msg_hi"]]
-            lo = v[:, self._msg_sl["msg_lo"]]
-            cnt = v[:, self._msg_sl["msg_cnt"]]
-            occ = hi != EMPTY
-            nhi, nlo = hi, lo
-            for fname in self.msg_server_fields:
-                val = self.packer.unpack(nhi, nlo, fname)
-                nhi, nlo = self.packer.replace(nhi, nlo, fname, sigma[jnp.clip(val, 0, S - 1)])
-            for fname in self.msg_server_nil_fields:
-                val = self.packer.unpack(nhi, nlo, fname)
-                mapped = jnp.where(val > 0, sigma[jnp.clip(val - 1, 0, S - 1)] + 1, 0)
-                nhi, nlo = self.packer.replace(nhi, nlo, fname, mapped)
-            nhi = jnp.where(occ, nhi, hi)
-            nlo = jnp.where(occ, nlo, lo)
-            nhi, nlo, cnt = lax.sort((nhi, nlo, cnt), num_keys=2)
-            v = (
-                v.at[:, self._msg_sl["msg_hi"]].set(nhi)
-                .at[:, self._msg_sl["msg_lo"]].set(nlo)
-                .at[:, self._msg_sl["msg_cnt"]].set(cnt)
-            )
+        if self._msg_word_sls:
+            words = [v[:, sl] for sl in self._msg_word_sls]
+            cnt = v[:, self._msg_cnt_sl]
+            occ = words[0] != EMPTY
+            nwords = list(words)
+            for fname, kind in self.msg_perm_spec:
+                val = self._unpack_key(nwords, fname)
+                if kind == "server":
+                    mapped = sigma[jnp.clip(val, 0, S - 1)]
+                elif kind == "server_nil":
+                    mapped = jnp.where(
+                        val > 0, sigma[jnp.clip(val - 1, 0, S - 1)] + 1, 0
+                    )
+                elif kind == "server_bitmask":
+                    bits = (val[..., None] >> jnp.arange(S, dtype=jnp.int32)) & 1
+                    mapped = jnp.sum(bits * pow2, axis=-1).astype(jnp.int32)
+                else:
+                    raise ValueError(f"unknown msg perm kind {kind}")
+                nwords = self._replace_key(nwords, fname, mapped)
+            nwords = [jnp.where(occ, nw, w) for nw, w in zip(nwords, words)]
+            sorted_all = lax.sort((*nwords, cnt), num_keys=len(nwords))
+            for sl, arr in zip(self._msg_word_sls, sorted_all[:-1]):
+                v = v.at[:, sl].set(arr)
+            v = v.at[:, self._msg_cnt_sl].set(sorted_all[-1])
         return hash_lanes(v)
 
     def _fingerprints(self, states):

@@ -1,0 +1,1158 @@
+"""Independent pure-Python interpreter of
+standard-raft/RaftWithReconfigJointConsensus.tla.
+
+Differential-testing ground truth for the TPU lowering in
+models/joint_raft.py, written directly against the TLA+ text (reference
+``/root/reference/specifications/standard-raft/
+RaftWithReconfigJointConsensus.tla``, 1,145 lines).
+
+Key structural deltas vs. the add/remove variant (see SURVEY.md §2.1):
+  - two-phase joint consensus: ``OldNewConfigCommand`` carries
+    (id, old, new, members=old ∪ added) and flips the config into
+    jointConsensus mode (``ConfigFor:279-290``); once committed, the
+    leader appends the matching ``NewConfigCommand``
+    (``CommittedOldNewWithoutNew:232-242``, ``AppendNewConfigToLog:861``);
+  - DUAL quorums while joint: ``BecomeLeader:511-528`` needs majorities of
+    both ``old`` and ``new``; ``AdvanceCommitIndex:613-653`` agrees in
+    both sets;
+  - the reconfiguration shape is constrained by ``ReconfigType:79-80``
+    (1=any, 2=one-for-one swap, 3=add-only, 4=remove-only,
+    ``IsValidReconfiguration:813-825``);
+  - ``MaxOneReconfigurationAtATime:1080-1101`` is an adjacency rule over
+    ALL servers' logs (same-type config commands must have the opposite
+    type strictly between them);
+  - ``ResetWithSameIdentity:391`` exists but is commented OUT of
+    ``Next:988`` — it is not a successor;
+  - ``Init:341-354`` seeds a ``NewConfigCommand`` first entry (not an
+    InitClusterCommand).
+
+Log entries are (command, term, value) with value:
+  AppendCommand       -> int v
+  OldNewConfigCommand -> (id, frozenset old, frozenset new, frozenset members)
+  NewConfigCommand    -> (id, frozenset members)
+
+Config tuples: (id, joint: bool, members, old, new, committed); old/new are
+empty frozensets when not joint (absent record fields encode as empty).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
+
+APPEND_CMD = "AppendCommand"
+OLDNEW_CMD = "OldNewConfigCommand"
+NEW_CMD = "NewConfigCommand"
+CONFIG_CMDS = (OLDNEW_CMD, NEW_CMD)
+
+OK, STALE_TERM, ENTRY_MISMATCH, NEED_SNAPSHOT = (
+    "Ok",
+    "StaleTerm",
+    "EntryMismatch",
+    "NeedSnapshot",
+)
+
+PENDING_SNAP_REQUEST = -1  # :293
+PENDING_SNAP_RESPONSE = -2  # :294
+
+EMPTY_FS = frozenset()
+NO_CONFIG = (0, False, EMPTY_FS, EMPTY_FS, EMPTY_FS, False)  # :267-271
+
+
+def rec(**kw) -> tuple:
+    return tuple(sorted(kw.items()))
+
+
+def last_term(log) -> int:
+    """LastTerm — :158."""
+    return log[-1][1] if log else 0
+
+
+def is_config_command(entry) -> bool:
+    """IsConfigCommand — :226-228."""
+    return entry[0] in CONFIG_CMDS
+
+
+def most_recent_reconfig_entry(log) -> tuple[int, tuple]:
+    """MostRecentReconfigEntry — :251-257."""
+    best = 0
+    for idx in range(1, len(log) + 1):
+        if is_config_command(log[idx - 1]):
+            best = idx
+    assert best > 0, "log has no config command"
+    return best, log[best - 1]
+
+
+def config_for(index: int, entry: tuple, ci: int) -> tuple:
+    """ConfigFor — :279-290."""
+    cmd, _term, val = entry
+    if cmd == OLDNEW_CMD:
+        cfg_id, old, new, members = val
+        return (cfg_id, True, members, old, new, ci >= index)
+    cfg_id, members = val
+    return (cfg_id, False, members, EMPTY_FS, EMPTY_FS, ci >= index)
+
+
+class JointRaftOracle:
+    def __init__(
+        self,
+        n_servers: int,
+        n_values: int,
+        init_cluster_size: int,
+        max_elections: int,
+        max_restarts: int,
+        max_reconfigs: int,
+        max_values_per_term: int,
+        reconfig_type: int,
+    ):
+        self.S = n_servers
+        self.V = n_values
+        self.init_cluster_size = init_cluster_size
+        self.max_elections = max_elections
+        self.max_restarts = max_restarts
+        self.max_reconfigs = max_reconfigs
+        self.max_values_per_term = max_values_per_term
+        self.reconfig_type = reconfig_type
+        self.max_term = 1 + max_elections
+
+    # ---------- state helpers ----------
+
+    def init_state(self) -> dict:
+        """Init — :341-354: pre-installed cluster; the seed entry is a
+        NewConfigCommand. CHOOSE realized as lowest indices."""
+        S, V = self.S, self.V
+        members = frozenset(range(self.init_cluster_size))
+        leader = 0
+        first = (NEW_CMD, 1, (1, members))
+        return {
+            "config": tuple(
+                (1, False, members, EMPTY_FS, EMPTY_FS, True)
+                if i in members
+                else NO_CONFIG
+                for i in range(S)
+            ),
+            "currentTerm": tuple(1 if i in members else 0 for i in range(S)),
+            "state": tuple(
+                LEADER if i == leader else FOLLOWER if i in members else NOTMEMBER
+                for i in range(S)
+            ),
+            "votedFor": (None,) * S,
+            "votesGranted": (frozenset(),) * S,
+            "nextIndex": tuple(
+                tuple(2 if (i == leader and j in members) else 1 for j in range(S))
+                for i in range(S)
+            ),
+            "matchIndex": tuple(
+                tuple(1 if (i == leader and j in members) else 0 for j in range(S))
+                for i in range(S)
+            ),
+            "pendingResponse": ((False,) * S,) * S,
+            "log": tuple((first,) if i in members else () for i in range(S)),
+            "commitIndex": tuple(1 if i in members else 0 for i in range(S)),
+            "messages": frozenset(),
+            "acked": (None,) * V,
+            "electionCtr": 0,
+            "restartCtr": 0,
+            "reconfigCtr": 0,
+            "valueCtr": (0,) * self.max_term,
+        }
+
+    @staticmethod
+    def _msgs(st) -> dict:
+        return dict(st["messages"])
+
+    @staticmethod
+    def _with(st, **updates) -> dict:
+        out = dict(st)
+        out.update(updates)
+        return out
+
+    @staticmethod
+    def _set(tup, i, val) -> tuple:
+        return tup[:i] + (val,) + tup[i + 1 :]
+
+    @classmethod
+    def _set2(cls, mat, i, j, val) -> tuple:
+        return cls._set(mat, i, cls._set(mat[i], j, val))
+
+    # ---------- message-bag helpers (:160-208) ----------
+
+    @staticmethod
+    def _send_no_restriction(msgs, m):
+        out = dict(msgs)
+        out[m] = out.get(m, 0) + 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _send_once(msgs, m):
+        if m in msgs:
+            return None
+        out = dict(msgs)
+        out[m] = 1
+        return frozenset(out.items())
+
+    @classmethod
+    def _send(cls, msgs, m):
+        """Send — :177-181: empty AppendEntriesRequest is send-once."""
+        d = dict(m)
+        if d["mtype"] == "AppendEntriesRequest" and d["mentries"] == ():
+            return cls._send_once(msgs, m)
+        return cls._send_no_restriction(msgs, m)
+
+    @staticmethod
+    def _send_multiple_once(msgs, ms):
+        if any(m in msgs for m in ms):
+            return None
+        out = dict(msgs)
+        for m in ms:
+            out[m] = 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _reply(msgs, response, request):
+        out = dict(msgs)
+        if out.get(request, 0) < 1:
+            return None
+        out[request] -= 1
+        out[response] = out.get(response, 0) + 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _discard(msgs, m):
+        out = dict(msgs)
+        assert out.get(m, 0) > 0
+        out[m] -= 1
+        return frozenset(out.items())
+
+    def _receivable(self, st, m, mtype: str, equal_term: bool) -> bool:
+        """ReceivableMessage — :212-218."""
+        d = dict(m)
+        msgs = self._msgs(st)
+        if msgs.get(m, 0) < 1 or d["mtype"] != mtype:
+            return False
+        if equal_term:
+            return d["mterm"] == st["currentTerm"][d["mdest"]]
+        return d["mterm"] <= st["currentTerm"][d["mdest"]]
+
+    @staticmethod
+    def _norm_rec(m) -> tuple:
+        def norm_val(v):
+            if v is None:
+                return (0, 0)
+            if isinstance(v, bool):
+                return (1, int(v))
+            if isinstance(v, int):
+                return (2, v)
+            if isinstance(v, str):
+                return (3, v)
+            if isinstance(v, frozenset):
+                return (4, tuple(sorted(v)))
+            if isinstance(v, tuple):
+                return (5, tuple(norm_val(x) for x in v))
+            raise TypeError(v)
+
+        return tuple((k, norm_val(v)) for k, v in m)
+
+    def _domain(self, st):
+        return sorted((m for m, _c in st["messages"]), key=self._norm_rec)
+
+    # ---------- config helpers ----------
+
+    def _has_pending_config(self, st, i) -> bool:
+        """HasPendingConfigCommand — :246-248."""
+        return st["config"][i][5] is False or st["config"][i][1] is True
+
+    def _quorum(self, subset, of) -> bool:
+        return subset <= of and 2 * len(subset) > len(of)
+
+    def _is_valid_reconfiguration(self, add, remove) -> bool:
+        """IsValidReconfiguration — :813-825."""
+        if self.reconfig_type == 2:
+            return len(add) == 1 and len(remove) == 1
+        if self.reconfig_type == 3:
+            return len(add) > 0 and len(remove) == 0
+        if self.reconfig_type == 4:
+            return len(add) == 0 and len(remove) > 0
+        return bool(add) or bool(remove)
+
+    # ---------- actions (Next order, :966-988) ----------
+
+    def successors(self, st) -> list[tuple[str, dict]]:
+        out = []
+        S, V = self.S, self.V
+        for i in range(S):
+            s2 = self.restart(st, i)
+            if s2 is not None:
+                out.append((f"Restart({i})", s2))
+        for m in self._domain(st):
+            s2 = self.update_term(st, m)
+            if s2 is not None:
+                out.append(("UpdateTerm", s2))
+        for i in range(S):
+            s2 = self.request_vote(st, i)
+            if s2 is not None:
+                out.append((f"RequestVote({i})", s2))
+        for i in range(S):
+            s2 = self.become_leader(st, i)
+            if s2 is not None:
+                out.append((f"BecomeLeader({i})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_request(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_response(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteResponse", s2))
+        for i in range(S):
+            for v in range(V):
+                s2 = self.client_request(st, i, v)
+                if s2 is not None:
+                    out.append((f"ClientRequest({i},{v})", s2))
+        for i in range(S):
+            s2 = self.advance_commit_index(st, i)
+            if s2 is not None:
+                out.append((f"AdvanceCommitIndex({i})", s2))
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.append_entries(st, i, j)
+                    if s2 is not None:
+                        out.append((f"AppendEntries({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.reject_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("RejectAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.accept_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("AcceptAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_append_entries_response(st, m)
+            if s2 is not None:
+                out.append(("HandleAppendEntriesResponse", s2))
+        for i in range(S):
+            for add, remove in self._reconfig_shapes():
+                s2 = self.append_old_new_config(st, i, add, remove)
+                if s2 is not None:
+                    out.append(
+                        (
+                            f"AppendOldNewConfigToLog({i},+{sorted(add)},-{sorted(remove)})",
+                            s2,
+                        )
+                    )
+        for i in range(S):
+            s2 = self.append_new_config(st, i)
+            if s2 is not None:
+                out.append((f"AppendNewConfigToLog({i})", s2))
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.send_snapshot(st, i, j)
+                    if s2 is not None:
+                        out.append((f"SendSnapshot({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_request(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_response(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotResponse", s2))
+        # ResetWithSameIdentity is commented out of Next (:988)
+        return out
+
+    def _reconfig_shapes(self):
+        """All (addMembers, removeMembers) subset pairs admitted by
+        IsValidReconfiguration (:813-825), in a deterministic order."""
+        servers = range(self.S)
+        subsets = []
+        for r in range(self.S + 1):
+            subsets += [frozenset(c) for c in itertools.combinations(servers, r)]
+        for add in subsets:
+            for remove in subsets:
+                if self._is_valid_reconfiguration(add, remove):
+                    yield add, remove
+
+    def restart(self, st, i):
+        """Restart(i) — :362-374."""
+        if st["restartCtr"] >= self.max_restarts:
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, FOLLOWER),
+            votesGranted=self._set(st["votesGranted"], i, frozenset()),
+            nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
+            commitIndex=self._set(st["commitIndex"], i, 0),
+            restartCtr=st["restartCtr"] + 1,
+        )
+
+    def update_term(self, st, m):
+        """UpdateTerm — :410-419."""
+        d = dict(m)
+        i = d["mdest"]
+        if d["mterm"] <= st["currentTerm"][i]:
+            return None
+        return self._with(
+            st,
+            currentTerm=self._set(st["currentTerm"], i, d["mterm"]),
+            state=self._set(st["state"], i, FOLLOWER),
+            votedFor=self._set(st["votedFor"], i, None),
+        )
+
+    def request_vote(self, st, i):
+        """RequestVote(i) — :431-450."""
+        if st["electionCtr"] >= self.max_elections:
+            return None
+        if st["state"][i] not in (FOLLOWER, CANDIDATE):
+            return None
+        members = st["config"][i][2]
+        if i not in members:
+            return None
+        reqs = {
+            rec(
+                mtype="RequestVoteRequest",
+                mterm=st["currentTerm"][i] + 1,
+                mlastLogTerm=last_term(st["log"][i]),
+                mlastLogIndex=len(st["log"][i]),
+                msource=i,
+                mdest=j,
+            )
+            for j in members
+            if j != i
+        }
+        msgs = self._send_multiple_once(self._msgs(st), reqs)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, CANDIDATE),
+            currentTerm=self._set(st["currentTerm"], i, st["currentTerm"][i] + 1),
+            votedFor=self._set(st["votedFor"], i, i),
+            votesGranted=self._set(st["votesGranted"], i, frozenset({i})),
+            electionCtr=st["electionCtr"] + 1,
+            messages=msgs,
+        )
+
+    def handle_request_vote_request(self, st, m):
+        """HandleRequestVoteRequest — :455-478."""
+        if not self._receivable(st, m, "RequestVoteRequest", equal_term=False):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        log_ok = d["mlastLogTerm"] > last_term(st["log"][i]) or (
+            d["mlastLogTerm"] == last_term(st["log"][i])
+            and d["mlastLogIndex"] >= len(st["log"][i])
+        )
+        grant = (
+            d["mterm"] == st["currentTerm"][i]
+            and log_ok
+            and st["votedFor"][i] in (None, j)
+        )
+        resp = rec(
+            mtype="RequestVoteResponse",
+            mterm=st["currentTerm"][i],
+            mvoteGranted=grant,
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        extra = {}
+        if grant:
+            extra["votedFor"] = self._set(st["votedFor"], i, j)
+        return self._with(st, messages=msgs, **extra)
+
+    def handle_request_vote_response(self, st, m):
+        """HandleRequestVoteResponse — :483-499."""
+        if not self._receivable(st, m, "RequestVoteResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != CANDIDATE:
+            return None
+        vg = st["votesGranted"][i] | {j} if d["mvoteGranted"] else st["votesGranted"][i]
+        return self._with(
+            st,
+            votesGranted=self._set(st["votesGranted"], i, vg),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    def become_leader(self, st, i):
+        """BecomeLeader(i) — :511-528: dual quorums while joint."""
+        if st["state"][i] != CANDIDATE:
+            return None
+        _id, joint, members, old, new, _committed = st["config"][i]
+        vg = st["votesGranted"][i]
+        if joint:
+            # VotesGrantedInSet (:508-509) intersects before the quorum test
+            if not (
+                self._quorum(vg & old, old) and self._quorum(vg & new, new)
+            ):
+                return None
+        else:
+            if not self._quorum(vg, members):
+                return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, LEADER),
+            nextIndex=self._set(
+                st["nextIndex"], i, (len(st["log"][i]) + 1,) * self.S
+            ),
+            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
+        )
+
+    def client_request(self, st, i, v):
+        """ClientRequest(i, v) — :535-550."""
+        if st["state"][i] != LEADER or st["acked"][v] is not None:
+            return None
+        term = st["currentTerm"][i]
+        if st["valueCtr"][term - 1] >= self.max_values_per_term:
+            return None
+        entry = (APPEND_CMD, term, v)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, st["log"][i] + (entry,)),
+            acked=self._set(st["acked"], v, False),
+            valueCtr=self._set(st["valueCtr"], term - 1, st["valueCtr"][term - 1] + 1),
+        )
+
+    def advance_commit_index(self, st, i):
+        """AdvanceCommitIndex(i) — :613-653: dual-quorum agreement while
+        joint (:626-629)."""
+        if st["state"][i] != LEADER:
+            return None
+        _id, joint, members, old, new, _committed = st["config"][i]
+        log_i = st["log"][i]
+
+        def agree(idx, member_set):
+            a = {k for k in member_set if st["matchIndex"][i][k] >= idx}
+            if i in member_set:
+                a |= {i}
+            return a
+
+        best = 0
+        for idx in range(1, len(log_i) + 1):
+            if joint:
+                ok = self._quorum(agree(idx, old), old) and self._quorum(
+                    agree(idx, new), new
+                )
+            else:
+                ok = self._quorum(agree(idx, members), members)
+            if ok:
+                best = idx
+        new_ci = (
+            best
+            if best > 0 and log_i[best - 1][1] == st["currentTerm"][i]
+            else st["commitIndex"][i]
+        )
+        if st["commitIndex"][i] >= new_ci:
+            return None
+        acked = list(st["acked"])
+        for idx in range(st["commitIndex"][i] + 1, new_ci + 1):
+            cmd, _t, val = log_i[idx - 1]
+            if cmd == APPEND_CMD and st["acked"][val] is False:
+                acked[val] = True
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(log_i)
+        new_config = config_for(cfg_idx, cfg_entry, new_ci)
+        # IsRemovedFromCluster (:606-611): NewConfigCommand without i
+        removed = any(
+            log_i[idx - 1][0] == NEW_CMD and i not in log_i[idx - 1][2][1]
+            for idx in range(st["commitIndex"][i] + 1, new_ci + 1)
+        )
+        upd = dict(
+            acked=tuple(acked),
+            config=self._set(st["config"], i, new_config),
+        )
+        if removed:
+            upd.update(
+                state=self._set(st["state"], i, NOTMEMBER),
+                votesGranted=self._set(st["votesGranted"], i, frozenset()),
+                nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+                matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+                commitIndex=self._set(st["commitIndex"], i, 0),
+            )
+        else:
+            upd["commitIndex"] = self._set(st["commitIndex"], i, new_ci)
+        return self._with(st, **upd)
+
+    def append_entries(self, st, i, j):
+        """AppendEntries(i, j) — :556-582."""
+        if st["state"][i] != LEADER:
+            return None
+        if j not in st["config"][i][2]:
+            return None
+        ni = st["nextIndex"][i][j]
+        if ni < 0 or st["pendingResponse"][i][j]:
+            return None
+        log_i = st["log"][i]
+        prev_idx = ni - 1
+        prev_term = log_i[prev_idx - 1][1] if prev_idx > 0 else 0
+        last_entry = min(len(log_i), ni)
+        entries = tuple(log_i[ni - 1 : last_entry])
+        msg = rec(
+            mtype="AppendEntriesRequest",
+            mterm=st["currentTerm"][i],
+            mprevLogIndex=prev_idx,
+            mprevLogTerm=prev_term,
+            mentries=entries,
+            mcommitIndex=min(st["commitIndex"][i], last_entry),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._send(self._msgs(st), msg)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            pendingResponse=self._set2(st["pendingResponse"], i, j, True),
+            messages=msgs,
+        )
+
+    def _log_ok(self, st, i, d) -> bool:
+        """LogOk — :660-677 (strict empty-entries arm)."""
+        log_i = st["log"][i]
+        if d["mentries"] != ():
+            return (
+                d["mprevLogIndex"] > 0
+                and d["mprevLogIndex"] <= len(log_i)
+                and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
+            )
+        return (
+            d["mprevLogIndex"] == len(log_i)
+            and d["mprevLogIndex"] > 0
+            and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
+        )
+
+    def reject_append_entries_request(self, st, m):
+        """RejectAppendEntriesRequest — :679-703."""
+        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=False):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if d["mterm"] < st["currentTerm"][i]:
+            rc = STALE_TERM
+        elif i not in st["config"][i][2]:
+            rc = NEED_SNAPSHOT
+        elif (
+            d["mterm"] == st["currentTerm"][i]
+            and st["state"][i] == FOLLOWER
+            and not self._log_ok(st, i, d)
+        ):
+            rc = ENTRY_MISMATCH
+        else:
+            return None
+        resp = rec(
+            mtype="AppendEntriesResponse",
+            mterm=st["currentTerm"][i],
+            mresult=rc,
+            mmatchIndex=0,
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(st, messages=msgs)
+
+    def accept_append_entries_request(self, st, m):
+        """AcceptAppendEntriesRequest — :726-763."""
+        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] not in (FOLLOWER, CANDIDATE):
+            return None
+        if not self._log_ok(st, i, d):
+            return None
+        if i not in st["config"][i][2]:
+            return None
+        log_i = st["log"][i]
+        index = d["mprevLogIndex"] + 1
+        if d["mentries"] != () and len(log_i) == d["mprevLogIndex"]:
+            new_log = log_i + (d["mentries"][0],)
+        elif d["mentries"] != () and len(log_i) >= index:
+            new_log = log_i[: d["mprevLogIndex"]] + (d["mentries"][0],)
+        else:
+            new_log = log_i
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(new_log)
+        new_config = config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
+        resp = rec(
+            mtype="AppendEntriesResponse",
+            mterm=st["currentTerm"][i],
+            mresult=OK,
+            mmatchIndex=d["mprevLogIndex"] + len(d["mentries"]),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            config=self._set(st["config"], i, new_config),
+            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
+            state=self._set(
+                st["state"], i, FOLLOWER if i in new_config[2] else NOTMEMBER
+            ),
+            log=self._set(st["log"], i, new_log),
+            messages=msgs,
+        )
+
+    def handle_append_entries_response(self, st, m):
+        """HandleAppendEntriesResponse — :768-798."""
+        if not self._receivable(st, m, "AppendEntriesResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != LEADER:
+            return None
+        ni = st["nextIndex"]
+        mi = st["matchIndex"]
+        if d["mresult"] == OK:
+            ni = self._set2(ni, i, j, d["mmatchIndex"] + 1)
+            mi = self._set2(mi, i, j, d["mmatchIndex"])
+        elif d["mresult"] == ENTRY_MISMATCH:
+            ni = self._set2(ni, i, j, max(st["nextIndex"][i][j] - 1, 1))
+        elif d["mresult"] == NEED_SNAPSHOT:
+            ni = self._set2(ni, i, j, PENDING_SNAP_REQUEST)
+        return self._with(
+            st,
+            nextIndex=ni,
+            matchIndex=mi,
+            pendingResponse=self._set2(st["pendingResponse"], i, j, False),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    # ---------- reconfiguration (:827-944) ----------
+
+    def append_old_new_config(self, st, i, add, remove):
+        """AppendOldNewConfigToLog — :827-856."""
+        if st["state"][i] != LEADER:
+            return None
+        if st["reconfigCtr"] >= self.max_reconfigs:
+            return None
+        if self._has_pending_config(st, i):
+            return None
+        members = st["config"][i][2]
+        if add & members != EMPTY_FS:
+            return None
+        if remove & members != remove:
+            return None
+        old = members
+        new = (members - remove) | add
+        joint_members = members | add
+        entry = (
+            OLDNEW_CMD,
+            st["currentTerm"][i],
+            (st["reconfigCtr"] + 1, old, new, joint_members),
+        )
+        new_log = st["log"][i] + (entry,)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, new_log),
+            config=self._set(
+                st["config"],
+                i,
+                config_for(len(new_log), entry, st["commitIndex"][i]),
+            ),
+            reconfigCtr=st["reconfigCtr"] + 1,
+            nextIndex=self._set(
+                st["nextIndex"],
+                i,
+                tuple(
+                    PENDING_SNAP_REQUEST
+                    if (s in new and s not in old)
+                    else st["nextIndex"][i][s]
+                    for s in range(self.S)
+                ),
+            ),
+        )
+
+    def append_new_config(self, st, i):
+        """AppendNewConfigToLog — :861-876 (the qualifying OldNew index,
+        when it exists, is unique: no later OldNew and no later New)."""
+        if st["state"][i] != LEADER:
+            return None
+        log_i = st["log"][i]
+        target = None
+        for idx in range(1, len(log_i) + 1):
+            # CommittedOldNewWithoutNew (:232-242)
+            if log_i[idx - 1][0] != OLDNEW_CMD:
+                continue
+            if st["commitIndex"][i] < idx:
+                continue
+            if any(
+                log_i[k - 1][0] == OLDNEW_CMD and k > idx
+                for k in range(1, len(log_i) + 1)
+            ):
+                continue
+            if any(
+                log_i[k - 1][0] == NEW_CMD and k > idx
+                for k in range(1, len(log_i) + 1)
+            ):
+                continue
+            target = idx
+            break
+        if target is None:
+            return None
+        oldnew = log_i[target - 1]
+        entry = (NEW_CMD, st["currentTerm"][i], (oldnew[2][0], oldnew[2][2]))
+        new_log = log_i + (entry,)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, new_log),
+            config=self._set(
+                st["config"],
+                i,
+                config_for(len(new_log), entry, st["commitIndex"][i]),
+            ),
+        )
+
+    def send_snapshot(self, st, i, j):
+        """SendSnapshot(i, j) — :885-901."""
+        if st["state"][i] != LEADER:
+            return None
+        if j not in st["config"][i][2]:
+            return None
+        if st["nextIndex"][i][j] != PENDING_SNAP_REQUEST:
+            return None
+        msg = rec(
+            mtype="SnapshotRequest",
+            mterm=st["currentTerm"][i],
+            mlog=st["log"][i],
+            mcommitIndex=st["commitIndex"][i],
+            mmembers=st["config"][i][2],
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._send(self._msgs(st), msg)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            nextIndex=self._set2(st["nextIndex"], i, j, PENDING_SNAP_RESPONSE),
+            messages=msgs,
+        )
+
+    def handle_snapshot_request(self, st, m):
+        """HandleSnapshotRequest — :905-927."""
+        if not self._receivable(st, m, "SnapshotRequest", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != FOLLOWER:
+            return None
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(d["mlog"])
+        resp = rec(
+            mtype="SnapshotResponse",
+            mterm=st["currentTerm"][i],
+            msuccess=True,
+            mmatchIndex=len(d["mlog"]),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
+            log=self._set(st["log"], i, d["mlog"]),
+            config=self._set(
+                st["config"], i, config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
+            ),
+            messages=msgs,
+        )
+
+    def handle_snapshot_response(self, st, m):
+        """HandleSnapshotResponse — :932-944."""
+        if not self._receivable(st, m, "SnapshotResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["nextIndex"][i][j] != PENDING_SNAP_RESPONSE:
+            return None
+        return self._with(
+            st,
+            nextIndex=self._set2(st["nextIndex"], i, j, d["mmatchIndex"] + 1),
+            matchIndex=self._set2(st["matchIndex"], i, j, d["mmatchIndex"]),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    # ---------- VIEW + SYMMETRY ----------
+
+    def _ser_msgs(self, msgs) -> tuple:
+        return tuple(sorted((self._norm_rec(m), c) for m, c in msgs))
+
+    @staticmethod
+    def _ser_log(log) -> tuple:
+        def ser_entry(e):
+            cmd, term, val = e
+            if cmd == APPEND_CMD:
+                return (cmd, term, (val,))
+            if cmd == NEW_CMD:
+                return (cmd, term, (val[0], tuple(sorted(val[1]))))
+            return (
+                cmd,
+                term,
+                (
+                    val[0],
+                    tuple(sorted(val[1])),
+                    tuple(sorted(val[2])),
+                    tuple(sorted(val[3])),
+                ),
+            )
+
+        return tuple(tuple(ser_entry(e) for e in lg) for lg in log)
+
+    def serialize_view(self, st) -> tuple:
+        """view — :144: all aux vars excluded."""
+        return (
+            tuple(
+                (
+                    c[0],
+                    c[1],
+                    tuple(sorted(c[2])),
+                    tuple(sorted(c[3])),
+                    tuple(sorted(c[4])),
+                    c[5],
+                )
+                for c in st["config"]
+            ),
+            st["currentTerm"],
+            st["state"],
+            tuple(-1 if v is None else v for v in st["votedFor"]),
+            tuple(tuple(sorted(vs)) for vs in st["votesGranted"]),
+            st["nextIndex"],
+            st["matchIndex"],
+            st["pendingResponse"],
+            self._ser_log(st["log"]),
+            st["commitIndex"],
+            self._ser_msgs(st["messages"]),
+        )
+
+    def serialize_full(self, st) -> tuple:
+        ack = {None: -1, False: 0, True: 1}
+        return self.serialize_view(st) + (
+            tuple(ack[a] for a in st["acked"]),
+            st["electionCtr"],
+            st["restartCtr"],
+            st["reconfigCtr"],
+            st["valueCtr"],
+        )
+
+    def permute(self, st, sigma) -> dict:
+        S = self.S
+        inv = [0] * S
+        for old, new in enumerate(sigma):
+            inv[new] = old
+
+        def prow(t):
+            return tuple(t[inv[k]] for k in range(S))
+
+        def pset(fs):
+            return frozenset(sigma[x] for x in fs)
+
+        def pentry(e):
+            cmd, term, val = e
+            if cmd == APPEND_CMD:
+                return e
+            if cmd == NEW_CMD:
+                return (cmd, term, (val[0], pset(val[1])))
+            return (cmd, term, (val[0], pset(val[1]), pset(val[2]), pset(val[3])))
+
+        def pmsg(m):
+            d = dict(m)
+            d["msource"] = sigma[d["msource"]]
+            d["mdest"] = sigma[d["mdest"]]
+            if "mentries" in d:
+                d["mentries"] = tuple(pentry(e) for e in d["mentries"])
+            if "mlog" in d:
+                d["mlog"] = tuple(pentry(e) for e in d["mlog"])
+            if "mmembers" in d:
+                d["mmembers"] = pset(d["mmembers"])
+            return rec(**d)
+
+        return self._with(
+            st,
+            config=tuple(
+                (c[0], c[1], pset(c[2]), pset(c[3]), pset(c[4]), c[5])
+                for c in prow(st["config"])
+            ),
+            currentTerm=prow(st["currentTerm"]),
+            state=prow(st["state"]),
+            votedFor=tuple(
+                None if v is None else sigma[v] for v in prow(st["votedFor"])
+            ),
+            votesGranted=tuple(
+                frozenset(sigma[j] for j in vs) for vs in prow(st["votesGranted"])
+            ),
+            nextIndex=tuple(prow(row) for row in prow(st["nextIndex"])),
+            matchIndex=tuple(prow(row) for row in prow(st["matchIndex"])),
+            pendingResponse=tuple(prow(row) for row in prow(st["pendingResponse"])),
+            log=tuple(tuple(pentry(e) for e in lg) for lg in prow(st["log"])),
+            commitIndex=prow(st["commitIndex"]),
+            messages=frozenset((pmsg(m), c) for m, c in st["messages"]),
+        )
+
+    def canon(self, st, symmetry: bool = True) -> tuple:
+        if not symmetry:
+            return self.serialize_view(st)
+        return min(
+            self.serialize_view(self.permute(st, list(sigma)))
+            for sigma in itertools.permutations(range(self.S))
+        )
+
+    # ---------- invariants (:1058-1140) ----------
+
+    def no_log_divergence(self, st) -> bool:
+        """NoLogDivergence — :1066-1074."""
+        for s1 in range(self.S):
+            for s2 in range(self.S):
+                if s1 == s2:
+                    continue
+                ci = min(st["commitIndex"][s1], st["commitIndex"][s2])
+                for idx in range(1, ci + 1):
+                    if st["log"][s1][idx - 1] != st["log"][s2][idx - 1]:
+                        return False
+        return True
+
+    def max_one_reconfiguration_at_a_time(self, st) -> bool:
+        """MaxOneReconfigurationAtATime — :1080-1101: two same-type config
+        commands must have the opposite type strictly between them."""
+        for command, other in ((OLDNEW_CMD, NEW_CMD), (NEW_CMD, OLDNEW_CMD)):
+            for i in range(self.S):
+                log_i = st["log"][i]
+                if len(log_i) <= 1:
+                    continue
+                idxs = [
+                    k for k in range(1, len(log_i) + 1) if log_i[k - 1][0] == command
+                ]
+                for a in range(len(idxs)):
+                    for b in range(a + 1, len(idxs)):
+                        ind1, ind2 = idxs[a], idxs[b]
+                        if ind2 - ind1 == 1:
+                            return False
+                        if not any(
+                            log_i[k - 1][0] == other
+                            for k in range(ind1 + 1, ind2)
+                        ):
+                            return False
+        return True
+
+    def leader_has_all_acked_values(self, st) -> bool:
+        """LeaderHasAllAckedValues — :1109-1125."""
+        for v in range(self.V):
+            if st["acked"][v] is not True:
+                continue
+            for i in range(self.S):
+                if st["state"][i] != LEADER:
+                    continue
+                if any(
+                    st["currentTerm"][l] > st["currentTerm"][i]
+                    for l in range(self.S)
+                    if l != i
+                ):
+                    continue
+                if not any(
+                    e[0] == APPEND_CMD and e[2] == v for e in st["log"][i]
+                ):
+                    return False
+        return True
+
+    def committed_entries_reach_majority(self, st) -> bool:
+        """CommittedEntriesReachMajority — :1129-1140."""
+        leaders = [
+            i
+            for i in range(self.S)
+            if st["state"][i] == LEADER and st["commitIndex"][i] > 0
+        ]
+        if not leaders:
+            return True
+        for i in leaders:
+            members = st["config"][i][2]
+            if i not in members:
+                continue
+            ci = st["commitIndex"][i]
+            if len(st["log"][i]) < ci:
+                continue
+            entry = st["log"][i][ci - 1]
+            agree = {
+                j
+                for j in members
+                if len(st["log"][j]) >= ci and st["log"][j][ci - 1] == entry
+            }
+            if i in agree and len(agree) >= len(members) // 2 + 1:
+                return True
+        return False
+
+    INVARIANTS = {
+        "NoLogDivergence": no_log_divergence,
+        "MaxOneReconfigurationAtATime": max_one_reconfiguration_at_a_time,
+        "LeaderHasAllAckedValues": leader_has_all_acked_values,
+        "CommittedEntriesReachMajority": committed_entries_reach_majority,
+        "TestInv": lambda self, st: True,
+    }
+
+    # ---------- BFS ----------
+
+    def bfs(
+        self,
+        invariants: tuple[str, ...] = (
+            "LeaderHasAllAckedValues",
+            "NoLogDivergence",
+            "MaxOneReconfigurationAtATime",
+        ),
+        symmetry: bool = True,
+        max_depth: int | None = None,
+        max_states: int | None = None,
+    ) -> dict:
+        init = self.init_state()
+        seen = {self.canon(init, symmetry)}
+        frontier = [init]
+        total = 1
+        distinct = 1
+        depth_counts = [1]
+        violation = None
+        depth = 0
+        while frontier and violation is None:
+            if max_depth is not None and depth >= max_depth:
+                break
+            next_frontier = []
+            for st in frontier:
+                for _label, s2 in self.successors(st):
+                    total += 1
+                    key = self.canon(s2, symmetry)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    distinct += 1
+                    for inv in invariants:
+                        if not self.INVARIANTS[inv](self, s2):
+                            violation = {
+                                "invariant": inv,
+                                "state": s2,
+                                "depth": depth + 1,
+                            }
+                            break
+                    next_frontier.append(s2)
+                    if violation or (max_states and distinct >= max_states):
+                        break
+                if violation or (max_states and distinct >= max_states):
+                    break
+            frontier = next_frontier
+            if frontier:
+                depth_counts.append(len(frontier))
+            depth += 1
+        return {
+            "distinct": distinct,
+            "total": total,
+            "depth_counts": depth_counts,
+            "violation": violation,
+        }

@@ -1,0 +1,1155 @@
+"""Independent pure-Python interpreter of
+standard-raft/RaftWithReconfigAddRemove.tla.
+
+Differential-testing ground truth for the TPU lowering in
+models/reconfig_raft.py, written directly against the TLA+ text (reference
+``/root/reference/specifications/standard-raft/RaftWithReconfigAddRemove.tla``,
+1,083 lines) — NOT against the JAX kernels.
+
+Key structural deltas vs. core Raft (see SURVEY.md §2.1):
+  - thesis-style one-at-a-time add/remove reconfiguration: config commands
+    live in the log (``AddServerCommand``/``RemoveServerCommand:66-69``),
+    the current config is derived from the most recent one
+    (``MostRecentReconfigEntry:252``, ``ConfigFor:265``);
+  - pre-installed cluster ``Init`` (``:324-338``): a CHOOSE-selected member
+    subset with a seeded ``InitClusterCommand`` first entry and an elected
+    leader (lowest indices, matching deterministic CHOOSE);
+  - snapshot catch-up for new members: ``SendSnapshot:862`` embeds the
+    leader's WHOLE log in the message; ``nextIndex`` uses the sentinels
+    ``PendingSnapshotRequest=-1``/``PendingSnapshotResponse=-2``
+    (``:271-272``);
+  - AppendEntries responses carry a result code
+    (``Ok/StaleTerm/EntryMismatch/NeedSnapshot:75``);
+  - member-aware quorums over ``config[i].members`` with leader
+    self-exclusion when removed (``AdvanceCommitIndex:612-615``);
+  - ``ResetWithSameIdentity:385`` is ENABLED in ``Next:965`` (drives the
+    README's split-brain data-loss scenario);
+  - ``IncludeThesisBug:92`` gates the
+    ``LeaderHasCommittedEntriesInCurrentTerm`` fix (``:801-803,833-835``);
+  - ``valueCtr`` bounds values per term (``ClientRequest:529``);
+  - the stricter ``LogOk:650-667``: an empty AppendEntries must line up
+    exactly with the end of the follower's log.
+
+State dict format (shared with ReconfigRaftModel.decode/encode):
+  config (per server: (id, frozenset members, committed)), currentTerm,
+  state, votedFor, votesGranted, log, commitIndex, nextIndex (may hold the
+  -1/-2 sentinels), matchIndex, pendingResponse, messages, acked,
+  electionCtr, restartCtr, addReconfigCtr, removeReconfigCtr,
+  valueCtr (tuple indexed by term-1).
+
+Log entries are (command, term, value) with value:
+  AppendCommand        -> int v
+  InitClusterCommand   -> (id, frozenset members)
+  AddServerCommand     -> (id, new_member, frozenset members)
+  RemoveServerCommand  -> (id, old_member, frozenset members)
+"""
+
+from __future__ import annotations
+
+import itertools
+
+FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
+
+INIT_CMD = "InitClusterCommand"
+APPEND_CMD = "AppendCommand"
+ADD_CMD = "AddServerCommand"
+REMOVE_CMD = "RemoveServerCommand"
+CONFIG_CMDS = (INIT_CMD, ADD_CMD, REMOVE_CMD)
+
+OK, STALE_TERM, ENTRY_MISMATCH, NEED_SNAPSHOT = (
+    "Ok",
+    "StaleTerm",
+    "EntryMismatch",
+    "NeedSnapshot",
+)
+
+PENDING_SNAP_REQUEST = -1  # RaftWithReconfigAddRemove.tla:271
+PENDING_SNAP_RESPONSE = -2  # :272
+
+NO_CONFIG = (0, frozenset(), False)  # NoConfig — :260-263
+
+
+def rec(**kw) -> tuple:
+    return tuple(sorted(kw.items()))
+
+
+def last_term(log) -> int:
+    """LastTerm — RaftWithReconfigAddRemove.tla:173."""
+    return log[-1][1] if log else 0
+
+
+def is_config_command(entry) -> bool:
+    """IsConfigCommand — RaftWithReconfigAddRemove.tla:241-244."""
+    return entry[0] in CONFIG_CMDS
+
+
+def most_recent_reconfig_entry(log) -> tuple[int, tuple]:
+    """MostRecentReconfigEntry — :252-258 (1-based index, entry)."""
+    best = 0
+    for idx in range(1, len(log) + 1):
+        if is_config_command(log[idx - 1]):
+            best = idx
+    assert best > 0, "log has no config command"
+    return best, log[best - 1]
+
+
+def config_for(index: int, entry: tuple, ci: int) -> tuple:
+    """ConfigFor — :265-268: (id, members, committed)."""
+    val = entry[2]
+    # value is (id, members) for Init, (id, new/old, members) otherwise
+    cfg_id = val[0]
+    members = val[-1]
+    return (cfg_id, members, ci >= index)
+
+
+class ReconfigRaftOracle:
+    def __init__(
+        self,
+        n_servers: int,
+        n_values: int,
+        init_cluster_size: int,
+        max_elections: int,
+        max_restarts: int,
+        max_values_per_term: int,
+        max_add_reconfigs: int,
+        max_remove_reconfigs: int,
+        min_cluster_size: int,
+        max_cluster_size: int,
+        include_thesis_bug: bool = False,
+    ):
+        self.S = n_servers
+        self.V = n_values
+        self.init_cluster_size = init_cluster_size
+        self.max_elections = max_elections
+        self.max_restarts = max_restarts
+        self.max_values_per_term = max_values_per_term
+        self.max_add = max_add_reconfigs
+        self.max_remove = max_remove_reconfigs
+        self.min_cluster = min_cluster_size
+        self.max_cluster = max_cluster_size
+        self.thesis_bug = include_thesis_bug
+        self.max_term = 1 + max_elections
+
+    # ---------- state helpers ----------
+
+    def init_state(self) -> dict:
+        """Init — :324-338. CHOOSE of the member subset and leader is
+        realized as lowest indices (deterministic; WLOG under SYMMETRY)."""
+        S, V = self.S, self.V
+        members = frozenset(range(self.init_cluster_size))
+        leader = 0
+        first = (INIT_CMD, 1, (1, members))
+        return {
+            "config": tuple(
+                (1, members, True) if i in members else NO_CONFIG for i in range(S)
+            ),
+            "currentTerm": tuple(1 if i in members else 0 for i in range(S)),
+            "state": tuple(
+                LEADER if i == leader else FOLLOWER if i in members else NOTMEMBER
+                for i in range(S)
+            ),
+            "votedFor": (None,) * S,
+            "votesGranted": (frozenset(),) * S,
+            "nextIndex": tuple(
+                tuple(
+                    2 if (i == leader and j in members) else 1 for j in range(S)
+                )
+                for i in range(S)
+            ),
+            "matchIndex": tuple(
+                tuple(
+                    1 if (i == leader and j in members) else 0 for j in range(S)
+                )
+                for i in range(S)
+            ),
+            "pendingResponse": ((False,) * S,) * S,
+            "log": tuple((first,) if i in members else () for i in range(S)),
+            "commitIndex": tuple(1 if i in members else 0 for i in range(S)),
+            "messages": frozenset(),
+            "acked": (None,) * V,
+            "electionCtr": 0,
+            "restartCtr": 0,
+            "addReconfigCtr": 0,
+            "removeReconfigCtr": 0,
+            "valueCtr": (0,) * self.max_term,
+        }
+
+    @staticmethod
+    def _msgs(st) -> dict:
+        return dict(st["messages"])
+
+    @staticmethod
+    def _with(st, **updates) -> dict:
+        out = dict(st)
+        out.update(updates)
+        return out
+
+    @staticmethod
+    def _set(tup, i, val) -> tuple:
+        return tup[:i] + (val,) + tup[i + 1 :]
+
+    @classmethod
+    def _set2(cls, mat, i, j, val) -> tuple:
+        return cls._set(mat, i, cls._set(mat[i], j, val))
+
+    # ---------- message-bag helpers (:175-223) ----------
+
+    @staticmethod
+    def _send_no_restriction(msgs, m):
+        out = dict(msgs)
+        out[m] = out.get(m, 0) + 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _send_once(msgs, m):
+        if m in msgs:
+            return None
+        out = dict(msgs)
+        out[m] = 1
+        return frozenset(out.items())
+
+    @classmethod
+    def _send(cls, msgs, m):
+        """Send — :192-196: empty AppendEntriesRequest is send-once."""
+        d = dict(m)
+        if d["mtype"] == "AppendEntriesRequest" and d["mentries"] == ():
+            return cls._send_once(msgs, m)
+        return cls._send_no_restriction(msgs, m)
+
+    @staticmethod
+    def _send_multiple_once(msgs, ms):
+        if any(m in msgs for m in ms):
+            return None
+        out = dict(msgs)
+        for m in ms:
+            out[m] = 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _reply(msgs, response, request):
+        """Reply — :217-223 (responses may duplicate here)."""
+        out = dict(msgs)
+        if out.get(request, 0) < 1:
+            return None
+        out[request] -= 1
+        out[response] = out.get(response, 0) + 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _discard(msgs, m):
+        out = dict(msgs)
+        assert out.get(m, 0) > 0
+        out[m] -= 1
+        return frozenset(out.items())
+
+    def _receivable(self, st, m, mtype: str, equal_term: bool) -> bool:
+        """ReceivableMessage — :227-233."""
+        d = dict(m)
+        msgs = self._msgs(st)
+        if msgs.get(m, 0) < 1 or d["mtype"] != mtype:
+            return False
+        if equal_term:
+            return d["mterm"] == st["currentTerm"][d["mdest"]]
+        return d["mterm"] <= st["currentTerm"][d["mdest"]]
+
+    @staticmethod
+    def _norm_rec(m) -> tuple:
+        """Totally orderable stand-in for a record (mixed value types)."""
+
+        def norm_val(v):
+            if v is None:
+                return (0, 0)
+            if isinstance(v, bool):
+                return (1, int(v))
+            if isinstance(v, int):
+                return (2, v)
+            if isinstance(v, str):
+                return (3, v)
+            if isinstance(v, frozenset):
+                return (4, tuple(sorted(v)))
+            if isinstance(v, tuple):
+                return (5, tuple(norm_val(x) for x in v))
+            raise TypeError(v)
+
+        return tuple((k, norm_val(v)) for k, v in m)
+
+    def _domain(self, st):
+        return sorted((m for m, _c in st["messages"]), key=self._norm_rec)
+
+    # ---------- config helpers ----------
+
+    def _has_pending_config(self, st, i) -> bool:
+        """HasPendingConfigCommand — :248-249."""
+        return st["config"][i][2] is False
+
+    def _leader_has_committed_in_term(self, st, i) -> bool:
+        """LeaderHasCommittedEntriesInCurrentTerm — :275-278."""
+        return any(
+            st["log"][i][idx][1] == st["currentTerm"][i]
+            and st["commitIndex"][i] >= idx + 1
+            for idx in range(len(st["log"][i]))
+        )
+
+    # ---------- actions (Next order, :943-965) ----------
+
+    def successors(self, st) -> list[tuple[str, dict]]:
+        out = []
+        S, V = self.S, self.V
+        for i in range(S):
+            s2 = self.restart(st, i)
+            if s2 is not None:
+                out.append((f"Restart({i})", s2))
+        for m in self._domain(st):
+            s2 = self.update_term(st, m)
+            if s2 is not None:
+                out.append(("UpdateTerm", s2))
+        for i in range(S):
+            s2 = self.request_vote(st, i)
+            if s2 is not None:
+                out.append((f"RequestVote({i})", s2))
+        for i in range(S):
+            s2 = self.become_leader(st, i)
+            if s2 is not None:
+                out.append((f"BecomeLeader({i})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_request(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_response(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteResponse", s2))
+        for i in range(S):
+            for v in range(V):
+                s2 = self.client_request(st, i, v)
+                if s2 is not None:
+                    out.append((f"ClientRequest({i},{v})", s2))
+        for i in range(S):
+            s2 = self.advance_commit_index(st, i)
+            if s2 is not None:
+                out.append((f"AdvanceCommitIndex({i})", s2))
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.append_entries(st, i, j)
+                    if s2 is not None:
+                        out.append((f"AppendEntries({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.reject_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("RejectAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.accept_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("AcceptAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_append_entries_response(st, m)
+            if s2 is not None:
+                out.append(("HandleAppendEntriesResponse", s2))
+        for i in range(S):
+            for a in range(S):
+                s2 = self.append_add_server_command(st, i, a)
+                if s2 is not None:
+                    out.append((f"AppendAddServerCommandToLog({i},{a})", s2))
+        for i in range(S):
+            for r in range(S):
+                s2 = self.append_remove_server_command(st, i, r)
+                if s2 is not None:
+                    out.append((f"AppendRemoveServerCommandToLog({i},{r})", s2))
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.send_snapshot(st, i, j)
+                    if s2 is not None:
+                        out.append((f"SendSnapshot({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_request(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_response(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotResponse", s2))
+        for i in range(S):
+            s2 = self.reset_with_same_identity(st, i)
+            if s2 is not None:
+                out.append((f"ResetWithSameIdentity({i})", s2))
+        return out
+
+    def restart(self, st, i):
+        """Restart(i) — :346-358: keeps config, currentTerm, votedFor, log."""
+        if st["restartCtr"] >= self.max_restarts:
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, FOLLOWER),
+            votesGranted=self._set(st["votesGranted"], i, frozenset()),
+            nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
+            commitIndex=self._set(st["commitIndex"], i, 0),
+            restartCtr=st["restartCtr"] + 1,
+        )
+
+    def update_term(self, st, m):
+        """UpdateTerm — :404-413 (any DOMAIN record, count may be 0)."""
+        d = dict(m)
+        i = d["mdest"]
+        if d["mterm"] <= st["currentTerm"][i]:
+            return None
+        return self._with(
+            st,
+            currentTerm=self._set(st["currentTerm"], i, d["mterm"]),
+            state=self._set(st["state"], i, FOLLOWER),
+            votedFor=self._set(st["votedFor"], i, None),
+        )
+
+    def request_vote(self, st, i):
+        """RequestVote(i) — :425-444: member-only, notifies the member set."""
+        if st["electionCtr"] >= self.max_elections:
+            return None
+        if st["state"][i] not in (FOLLOWER, CANDIDATE):
+            return None
+        members = st["config"][i][1]
+        if i not in members:
+            return None
+        reqs = {
+            rec(
+                mtype="RequestVoteRequest",
+                mterm=st["currentTerm"][i] + 1,
+                mlastLogTerm=last_term(st["log"][i]),
+                mlastLogIndex=len(st["log"][i]),
+                msource=i,
+                mdest=j,
+            )
+            for j in members
+            if j != i
+        }
+        msgs = self._send_multiple_once(self._msgs(st), reqs)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, CANDIDATE),
+            currentTerm=self._set(st["currentTerm"], i, st["currentTerm"][i] + 1),
+            votedFor=self._set(st["votedFor"], i, i),
+            votesGranted=self._set(st["votesGranted"], i, frozenset({i})),
+            electionCtr=st["electionCtr"] + 1,
+            messages=msgs,
+        )
+
+    def handle_request_vote_request(self, st, m):
+        """HandleRequestVoteRequest — :449-472."""
+        if not self._receivable(st, m, "RequestVoteRequest", equal_term=False):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        log_ok = d["mlastLogTerm"] > last_term(st["log"][i]) or (
+            d["mlastLogTerm"] == last_term(st["log"][i])
+            and d["mlastLogIndex"] >= len(st["log"][i])
+        )
+        grant = (
+            d["mterm"] == st["currentTerm"][i]
+            and log_ok
+            and st["votedFor"][i] in (None, j)
+        )
+        resp = rec(
+            mtype="RequestVoteResponse",
+            mterm=st["currentTerm"][i],
+            mvoteGranted=grant,
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        extra = {}
+        if grant:
+            extra["votedFor"] = self._set(st["votedFor"], i, j)
+        return self._with(st, messages=msgs, **extra)
+
+    def handle_request_vote_response(self, st, m):
+        """HandleRequestVoteResponse — :477-493."""
+        if not self._receivable(st, m, "RequestVoteResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != CANDIDATE:
+            return None
+        vg = st["votesGranted"][i] | {j} if d["mvoteGranted"] else st["votesGranted"][i]
+        return self._with(
+            st,
+            votesGranted=self._set(st["votesGranted"], i, vg),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    def become_leader(self, st, i):
+        """BecomeLeader(i) — :505-518: quorum of config[i].members; the vote
+        set must itself be a subset of the member set."""
+        if st["state"][i] != CANDIDATE:
+            return None
+        members = st["config"][i][1]
+        vg = st["votesGranted"][i]
+        if not (vg <= members and 2 * len(vg) > len(members)):
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, LEADER),
+            nextIndex=self._set(
+                st["nextIndex"], i, (len(st["log"][i]) + 1,) * self.S
+            ),
+            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
+        )
+
+    def client_request(self, st, i, v):
+        """ClientRequest(i, v) — :525-540: also bounded by valueCtr per
+        term (:529)."""
+        if st["state"][i] != LEADER or st["acked"][v] is not None:
+            return None
+        term = st["currentTerm"][i]
+        if st["valueCtr"][term - 1] >= self.max_values_per_term:
+            return None
+        entry = (APPEND_CMD, term, v)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, st["log"][i] + (entry,)),
+            acked=self._set(st["acked"], v, False),
+            valueCtr=self._set(st["valueCtr"], term - 1, st["valueCtr"][term - 1] + 1),
+        )
+
+    def advance_commit_index(self, st, i):
+        """AdvanceCommitIndex(i) — :605-642: member-set quorum with leader
+        self-exclusion when removed; derives config; the leader leaves the
+        cluster on committing its own removal (:633-640)."""
+        if st["state"][i] != LEADER:
+            return None
+        members = st["config"][i][1]
+        log_i = st["log"][i]
+        best = 0
+        for idx in range(1, len(log_i) + 1):
+            agree = {k for k in members if st["matchIndex"][i][k] >= idx}
+            if i in members:
+                agree |= {i}
+            # Agree set must be a quorum of the member set (:617-618)
+            if agree <= members and 2 * len(agree) > len(members):
+                best = idx
+        new_ci = (
+            best
+            if best > 0 and log_i[best - 1][1] == st["currentTerm"][i]
+            else st["commitIndex"][i]
+        )
+        if st["commitIndex"][i] >= new_ci:
+            return None
+        acked = list(st["acked"])
+        for idx in range(st["commitIndex"][i] + 1, new_ci + 1):
+            cmd, _t, val = log_i[idx - 1]
+            if cmd == APPEND_CMD and st["acked"][val] is False:
+                acked[val] = True
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(log_i)
+        new_config = config_for(cfg_idx, cfg_entry, new_ci)
+        removed = any(
+            log_i[idx - 1][0] == REMOVE_CMD and i not in log_i[idx - 1][2][-1]
+            for idx in range(st["commitIndex"][i] + 1, new_ci + 1)
+        )
+        upd = dict(
+            acked=tuple(acked),
+            config=self._set(st["config"], i, new_config),
+        )
+        if removed:
+            upd.update(
+                state=self._set(st["state"], i, NOTMEMBER),
+                votesGranted=self._set(st["votesGranted"], i, frozenset()),
+                nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+                matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+                commitIndex=self._set(st["commitIndex"], i, 0),
+            )
+        else:
+            upd["commitIndex"] = self._set(st["commitIndex"], i, new_ci)
+        return self._with(st, **upd)
+
+    def append_entries(self, st, i, j):
+        """AppendEntries(i, j) — :546-572: member-gated, snapshot-sentinel
+        gated, one-at-a-time flow control."""
+        if st["state"][i] != LEADER:
+            return None
+        if j not in st["config"][i][1]:
+            return None
+        ni = st["nextIndex"][i][j]
+        if ni < 0 or st["pendingResponse"][i][j]:
+            return None
+        log_i = st["log"][i]
+        prev_idx = ni - 1
+        prev_term = log_i[prev_idx - 1][1] if prev_idx > 0 else 0
+        last_entry = min(len(log_i), ni)
+        entries = tuple(log_i[ni - 1 : last_entry])
+        msg = rec(
+            mtype="AppendEntriesRequest",
+            mterm=st["currentTerm"][i],
+            mprevLogIndex=prev_idx,
+            mprevLogTerm=prev_term,
+            mentries=entries,
+            mcommitIndex=min(st["commitIndex"][i], last_entry),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._send(self._msgs(st), msg)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            pendingResponse=self._set2(st["pendingResponse"], i, j, True),
+            messages=msgs,
+        )
+
+    def _log_ok(self, st, i, d) -> bool:
+        """LogOk — :650-667 (strict empty-entries arm)."""
+        log_i = st["log"][i]
+        if d["mentries"] != ():
+            return (
+                d["mprevLogIndex"] > 0
+                and d["mprevLogIndex"] <= len(log_i)
+                and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
+            )
+        return (
+            d["mprevLogIndex"] == len(log_i)
+            and d["mprevLogIndex"] > 0
+            and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
+        )
+
+    def reject_append_entries_request(self, st, m):
+        """RejectAppendEntriesRequest — :669-693."""
+        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=False):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if d["mterm"] < st["currentTerm"][i]:
+            rc = STALE_TERM
+        elif i not in st["config"][i][1]:
+            rc = NEED_SNAPSHOT
+        elif (
+            d["mterm"] == st["currentTerm"][i]
+            and st["state"][i] == FOLLOWER
+            and not self._log_ok(st, i, d)
+        ):
+            rc = ENTRY_MISMATCH
+        else:
+            return None
+        resp = rec(
+            mtype="AppendEntriesResponse",
+            mterm=st["currentTerm"][i],
+            mresult=rc,
+            mmatchIndex=0,
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(st, messages=msgs)
+
+    def accept_append_entries_request(self, st, m):
+        """AcceptAppendEntriesRequest — :716-753: append/truncate, then
+        derive config from the new log; may demote to NotMember."""
+        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] not in (FOLLOWER, CANDIDATE):
+            return None
+        if not self._log_ok(st, i, d):
+            return None
+        if i not in st["config"][i][1]:
+            return None
+        log_i = st["log"][i]
+        index = d["mprevLogIndex"] + 1
+        if d["mentries"] != () and len(log_i) == d["mprevLogIndex"]:
+            new_log = log_i + (d["mentries"][0],)  # CanAppend (:705-707)
+        elif d["mentries"] != () and len(log_i) >= index:
+            # NeedsTruncation (:709-711) + TruncateLog (:713-714)
+            new_log = log_i[: d["mprevLogIndex"]] + (d["mentries"][0],)
+        else:
+            new_log = log_i
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(new_log)
+        new_config = config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
+        resp = rec(
+            mtype="AppendEntriesResponse",
+            mterm=st["currentTerm"][i],
+            mresult=OK,
+            mmatchIndex=d["mprevLogIndex"] + len(d["mentries"]),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            config=self._set(st["config"], i, new_config),
+            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
+            state=self._set(
+                st["state"],
+                i,
+                FOLLOWER if i in new_config[1] else NOTMEMBER,
+            ),
+            log=self._set(st["log"], i, new_log),
+            messages=msgs,
+        )
+
+    def handle_append_entries_response(self, st, m):
+        """HandleAppendEntriesResponse — :758-788."""
+        if not self._receivable(st, m, "AppendEntriesResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != LEADER:
+            return None
+        ni = st["nextIndex"]
+        mi = st["matchIndex"]
+        if d["mresult"] == OK:
+            ni = self._set2(ni, i, j, d["mmatchIndex"] + 1)
+            mi = self._set2(mi, i, j, d["mmatchIndex"])
+        elif d["mresult"] == ENTRY_MISMATCH:
+            ni = self._set2(ni, i, j, max(st["nextIndex"][i][j] - 1, 1))
+        elif d["mresult"] == NEED_SNAPSHOT:
+            ni = self._set2(ni, i, j, PENDING_SNAP_REQUEST)
+        # StaleTerm: no index changes (:784-785)
+        return self._with(
+            st,
+            nextIndex=ni,
+            matchIndex=mi,
+            pendingResponse=self._set2(st["pendingResponse"], i, j, False),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    # ---------- reconfiguration (:795-921) ----------
+
+    def append_add_server_command(self, st, i, add_member):
+        """AppendAddServerCommandToLog — :795-824."""
+        if st["state"][i] != LEADER:
+            return None
+        if st["addReconfigCtr"] >= self.max_add:
+            return None
+        cfg_id, members, _committed = st["config"][i]
+        if len(members) >= self.max_cluster:
+            return None
+        if self._has_pending_config(st, i):
+            return None
+        if not self.thesis_bug and not self._leader_has_committed_in_term(st, i):
+            return None
+        if add_member in members:
+            return None
+        entry = (ADD_CMD, st["currentTerm"][i], (cfg_id + 1, add_member, members | {add_member}))
+        new_log = st["log"][i] + (entry,)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, new_log),
+            config=self._set(
+                st["config"],
+                i,
+                config_for(len(new_log), entry, st["commitIndex"][i]),
+            ),
+            addReconfigCtr=st["addReconfigCtr"] + 1,
+            nextIndex=self._set(
+                st["nextIndex"],
+                i,
+                tuple(
+                    PENDING_SNAP_REQUEST if s == add_member else st["nextIndex"][i][s]
+                    for s in range(self.S)
+                ),
+            ),
+        )
+
+    def append_remove_server_command(self, st, i, remove_member):
+        """AppendRemoveServerCommandToLog — :828-853."""
+        if st["state"][i] != LEADER:
+            return None
+        if st["removeReconfigCtr"] >= self.max_remove:
+            return None
+        cfg_id, members, _committed = st["config"][i]
+        if len(members) <= self.min_cluster:
+            return None
+        if not self.thesis_bug and not self._leader_has_committed_in_term(st, i):
+            return None
+        if self._has_pending_config(st, i):
+            return None
+        if remove_member not in members:
+            return None
+        entry = (
+            REMOVE_CMD,
+            st["currentTerm"][i],
+            (cfg_id + 1, remove_member, members - {remove_member}),
+        )
+        new_log = st["log"][i] + (entry,)
+        return self._with(
+            st,
+            log=self._set(st["log"], i, new_log),
+            config=self._set(
+                st["config"],
+                i,
+                config_for(len(new_log), entry, st["commitIndex"][i]),
+            ),
+            removeReconfigCtr=st["removeReconfigCtr"] + 1,
+        )
+
+    def send_snapshot(self, st, i, j):
+        """SendSnapshot(i, j) — :862-878: embeds the leader's whole log."""
+        if st["state"][i] != LEADER:
+            return None
+        if j not in st["config"][i][1]:
+            return None
+        if st["nextIndex"][i][j] != PENDING_SNAP_REQUEST:
+            return None
+        msg = rec(
+            mtype="SnapshotRequest",
+            mterm=st["currentTerm"][i],
+            mlog=st["log"][i],
+            mcommitIndex=st["commitIndex"][i],
+            mmembers=st["config"][i][1],
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._send(self._msgs(st), msg)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            nextIndex=self._set2(st["nextIndex"], i, j, PENDING_SNAP_RESPONSE),
+            messages=msgs,
+        )
+
+    def handle_snapshot_request(self, st, m):
+        """HandleSnapshotRequest — :882-904."""
+        if not self._receivable(st, m, "SnapshotRequest", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["state"][i] != FOLLOWER:
+            return None
+        cfg_idx, cfg_entry = most_recent_reconfig_entry(d["mlog"])
+        resp = rec(
+            mtype="SnapshotResponse",
+            mterm=st["currentTerm"][i],
+            msuccess=True,
+            mmatchIndex=len(d["mlog"]),
+            msource=i,
+            mdest=j,
+        )
+        msgs = self._reply(self._msgs(st), resp, m)
+        if msgs is None:
+            return None
+        return self._with(
+            st,
+            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
+            log=self._set(st["log"], i, d["mlog"]),
+            config=self._set(
+                st["config"], i, config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
+            ),
+            messages=msgs,
+        )
+
+    def handle_snapshot_response(self, st, m):
+        """HandleSnapshotResponse — :909-921."""
+        if not self._receivable(st, m, "SnapshotResponse", equal_term=True):
+            return None
+        d = dict(m)
+        i, j = d["mdest"], d["msource"]
+        if st["nextIndex"][i][j] != PENDING_SNAP_RESPONSE:
+            return None
+        return self._with(
+            st,
+            nextIndex=self._set2(st["nextIndex"], i, j, d["mmatchIndex"] + 1),
+            matchIndex=self._set2(st["matchIndex"], i, j, d["mmatchIndex"]),
+            messages=self._discard(self._msgs(st), m),
+        )
+
+    def reset_with_same_identity(self, st, i):
+        """ResetWithSameIdentity(i) — :385-400 (enabled in Next:965); wipes
+        a server the current leader confirms is outside the committed
+        config."""
+        if st["currentTerm"][i] <= 0:
+            return None
+        # IsSafeToWipe (:375-383); CHOOSE leader = lowest current leader
+        leaders = [
+            s
+            for s in range(self.S)
+            if st["state"][s] == LEADER
+            and not any(
+                st["currentTerm"][l] > st["currentTerm"][s]
+                for l in range(self.S)
+                if l != s
+            )
+        ]
+        if not leaders:
+            return None
+        leader = leaders[0]
+        if leader == i or i in st["config"][leader][1]:
+            return None
+        if not st["config"][leader][2]:
+            return None
+        return self._with(
+            st,
+            state=self._set(st["state"], i, NOTMEMBER),
+            config=self._set(st["config"], i, NO_CONFIG),
+            currentTerm=self._set(st["currentTerm"], i, 0),
+            votedFor=self._set(st["votedFor"], i, None),
+            votesGranted=self._set(st["votesGranted"], i, frozenset()),
+            nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
+            commitIndex=self._set(st["commitIndex"], i, 0),
+            log=self._set(st["log"], i, ()),
+        )
+
+    # ---------- VIEW + SYMMETRY ----------
+
+    def _ser_msgs(self, msgs) -> tuple:
+        return tuple(sorted((self._norm_rec(m), c) for m, c in msgs))
+
+    @staticmethod
+    def _ser_log(log) -> tuple:
+        def ser_entry(e):
+            cmd, term, val = e
+            if cmd == APPEND_CMD:
+                return (cmd, term, (val,))
+            if cmd == INIT_CMD:
+                return (cmd, term, (val[0], tuple(sorted(val[1]))))
+            return (cmd, term, (val[0], val[1], tuple(sorted(val[2]))))
+
+        return tuple(tuple(ser_entry(e) for e in lg) for lg in log)
+
+    def serialize_view(self, st) -> tuple:
+        """view — :159: messages, serverVars, candidateVars, leaderVars,
+        logVars; ALL aux vars (incl. acked) excluded."""
+        return (
+            tuple(
+                (c[0], tuple(sorted(c[1])), c[2]) for c in st["config"]
+            ),
+            st["currentTerm"],
+            st["state"],
+            tuple(-1 if v is None else v for v in st["votedFor"]),
+            tuple(tuple(sorted(vs)) for vs in st["votesGranted"]),
+            st["nextIndex"],
+            st["matchIndex"],
+            st["pendingResponse"],
+            self._ser_log(st["log"]),
+            st["commitIndex"],
+            self._ser_msgs(st["messages"]),
+        )
+
+    def serialize_full(self, st) -> tuple:
+        ack = {None: -1, False: 0, True: 1}
+        return self.serialize_view(st) + (
+            tuple(ack[a] for a in st["acked"]),
+            st["electionCtr"],
+            st["restartCtr"],
+            st["addReconfigCtr"],
+            st["removeReconfigCtr"],
+            st["valueCtr"],
+        )
+
+    def permute(self, st, sigma) -> dict:
+        """Apply a server permutation (old -> new index)."""
+        S = self.S
+        inv = [0] * S
+        for old, new in enumerate(sigma):
+            inv[new] = old
+
+        def prow(t):
+            return tuple(t[inv[k]] for k in range(S))
+
+        def pentry(e):
+            cmd, term, val = e
+            if cmd == APPEND_CMD:
+                return e
+            if cmd == INIT_CMD:
+                return (cmd, term, (val[0], frozenset(sigma[x] for x in val[1])))
+            return (
+                cmd,
+                term,
+                (val[0], sigma[val[1]], frozenset(sigma[x] for x in val[2])),
+            )
+
+        def pmsg(m):
+            d = dict(m)
+            d["msource"] = sigma[d["msource"]]
+            d["mdest"] = sigma[d["mdest"]]
+            if "mentries" in d:
+                d["mentries"] = tuple(pentry(e) for e in d["mentries"])
+            if "mlog" in d:
+                d["mlog"] = tuple(pentry(e) for e in d["mlog"])
+            if "mmembers" in d:
+                d["mmembers"] = frozenset(sigma[x] for x in d["mmembers"])
+            return rec(**d)
+
+        return self._with(
+            st,
+            config=tuple(
+                (c[0], frozenset(sigma[x] for x in c[1]), c[2])
+                for c in prow(st["config"])
+            ),
+            currentTerm=prow(st["currentTerm"]),
+            state=prow(st["state"]),
+            votedFor=tuple(
+                None if v is None else sigma[v] for v in prow(st["votedFor"])
+            ),
+            votesGranted=tuple(
+                frozenset(sigma[j] for j in vs) for vs in prow(st["votesGranted"])
+            ),
+            nextIndex=tuple(prow(row) for row in prow(st["nextIndex"])),
+            matchIndex=tuple(prow(row) for row in prow(st["matchIndex"])),
+            pendingResponse=tuple(prow(row) for row in prow(st["pendingResponse"])),
+            log=tuple(tuple(pentry(e) for e in lg) for lg in prow(st["log"])),
+            commitIndex=prow(st["commitIndex"]),
+            messages=frozenset((pmsg(m), c) for m, c in st["messages"]),
+        )
+
+    def canon(self, st, symmetry: bool = True) -> tuple:
+        if not symmetry:
+            return self.serialize_view(st)
+        return min(
+            self.serialize_view(self.permute(st, list(sigma)))
+            for sigma in itertools.permutations(range(self.S))
+        )
+
+    # ---------- invariants (:1009-1078) ----------
+
+    def no_log_divergence(self, st) -> bool:
+        """NoLogDivergence — :1017-1025 (full-entry equality)."""
+        for s1 in range(self.S):
+            for s2 in range(self.S):
+                if s1 == s2:
+                    continue
+                ci = min(st["commitIndex"][s1], st["commitIndex"][s2])
+                for idx in range(1, ci + 1):
+                    if st["log"][s1][idx - 1] != st["log"][s2][idx - 1]:
+                        return False
+        return True
+
+    def max_one_reconfiguration_at_a_time(self, st) -> bool:
+        """MaxOneReconfigurationAtATime — :1031-1039."""
+        for i in range(self.S):
+            if st["state"][i] != LEADER:
+                continue
+            uncommitted = [
+                idx
+                for idx in range(1, len(st["log"][i]) + 1)
+                if is_config_command(st["log"][i][idx - 1])
+                and st["commitIndex"][i] < idx
+            ]
+            if len(uncommitted) >= 2:
+                return False
+        return True
+
+    def leader_has_all_acked_values(self, st) -> bool:
+        """LeaderHasAllAckedValues — :1047-1063 (value-field comparison:
+        only AppendCommand entries can match a client value)."""
+        for v in range(self.V):
+            if st["acked"][v] is not True:
+                continue
+            for i in range(self.S):
+                if st["state"][i] != LEADER:
+                    continue
+                if any(
+                    st["currentTerm"][l] > st["currentTerm"][i]
+                    for l in range(self.S)
+                    if l != i
+                ):
+                    continue
+                if not any(
+                    e[0] == APPEND_CMD and e[2] == v for e in st["log"][i]
+                ):
+                    return False
+        return True
+
+    def committed_entries_reach_majority(self, st) -> bool:
+        """CommittedEntriesReachMajority — :1067-1078 (quorum drawn from
+        config[i].members and must contain i)."""
+        leaders = [
+            i
+            for i in range(self.S)
+            if st["state"][i] == LEADER and st["commitIndex"][i] > 0
+        ]
+        if not leaders:
+            return True
+        for i in leaders:
+            members = st["config"][i][1]
+            if i not in members:
+                continue
+            ci = st["commitIndex"][i]
+            if len(st["log"][i]) < ci:
+                continue
+            entry = st["log"][i][ci - 1]
+            agree = {
+                j
+                for j in members
+                if len(st["log"][j]) >= ci and st["log"][j][ci - 1] == entry
+            }
+            if i in agree and len(agree) >= len(members) // 2 + 1:
+                return True
+        return False
+
+    INVARIANTS = {
+        "NoLogDivergence": no_log_divergence,
+        "MaxOneReconfigurationAtATime": max_one_reconfiguration_at_a_time,
+        "LeaderHasAllAckedValues": leader_has_all_acked_values,
+        "CommittedEntriesReachMajority": committed_entries_reach_majority,
+        "TestInv": lambda self, st: True,
+    }
+
+    # ---------- BFS ----------
+
+    def bfs(
+        self,
+        invariants: tuple[str, ...] = (
+            "LeaderHasAllAckedValues",
+            "NoLogDivergence",
+            "MaxOneReconfigurationAtATime",
+        ),
+        symmetry: bool = True,
+        max_depth: int | None = None,
+        max_states: int | None = None,
+    ) -> dict:
+        init = self.init_state()
+        seen = {self.canon(init, symmetry)}
+        frontier = [init]
+        total = 1
+        distinct = 1
+        depth_counts = [1]
+        violation = None
+        depth = 0
+        while frontier and violation is None:
+            if max_depth is not None and depth >= max_depth:
+                break
+            next_frontier = []
+            for st in frontier:
+                for _label, s2 in self.successors(st):
+                    total += 1
+                    key = self.canon(s2, symmetry)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    distinct += 1
+                    for inv in invariants:
+                        if not self.INVARIANTS[inv](self, s2):
+                            violation = {
+                                "invariant": inv,
+                                "state": s2,
+                                "depth": depth + 1,
+                            }
+                            break
+                    next_frontier.append(s2)
+                    if violation or (max_states and distinct >= max_states):
+                        break
+                if violation or (max_states and distinct >= max_states):
+                    break
+            frontier = next_frontier
+            if frontier:
+                depth_counts.append(len(frontier))
+            depth += 1
+        return {
+            "distinct": distinct,
+            "total": total,
+            "depth_counts": depth_counts,
+            "violation": violation,
+        }

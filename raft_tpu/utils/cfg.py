@@ -48,6 +48,10 @@ class Cfg:
     # recoverable cfg bugs found while parsing (e.g. PullRaft.cfg's
     # undeclared `v2`); parse_cfg raises on these unless lenient=True
     diagnostics: list[str] = field(default_factory=list)
+    # whether recoverable bugs should be repaired (set by parse_cfg; spec
+    # builders consult this for builder-level diagnoses such as the missing
+    # MaxClusterSize in RaftWithReconfigAddRemove.cfg)
+    lenient: bool = False
 
     def server_like(self, name: str) -> list[str]:
         v = self.constants.get(name)
@@ -86,7 +90,7 @@ def parse_cfg(path: str, text: str | None = None, lenient: bool = False) -> Cfg:
     if text is None:
         with open(path) as f:
             text = f.read()
-    cfg = Cfg(path=path)
+    cfg = Cfg(path=path, lenient=lenient)
     section = None
     pending: list[str] = []  # tokens for CONSTANTS assignments spanning lines
 
