@@ -27,6 +27,20 @@ def main(argv=None):
     )
     ap.add_argument("--max-depth", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
+    ap.add_argument(
+        "--simulate",
+        type=int,
+        default=None,
+        metavar="N",
+        help="simulation mode (TLC -simulate): run N random behaviors "
+        "instead of exhaustive BFS — the reference's prescribed mode for "
+        "FlexibleRaft.cfg and KRaftWithReconfig.cfg",
+    )
+    ap.add_argument("--sim-depth", type=int, default=50,
+                    help="max behavior length in simulation mode")
+    ap.add_argument("--sim-walks", type=int, default=128,
+                    help="parallel walks per device batch in simulation mode")
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--msg-slots", type=int, default=None,
                     help="message-bag slot count (default: per-spec)")
     ap.add_argument("--no-symmetry", action="store_true", help="ignore SYMMETRY")
@@ -75,6 +89,14 @@ def main(argv=None):
         f"symmetry={symmetry} checker={args.checker}"
     )
 
+    if args.checker == "oracle" and args.simulate is not None:
+        print(
+            "error: --simulate requires the tpu checker (the oracle backend "
+            "is exhaustive-only)",
+            file=sys.stderr,
+        )
+        return 64
+
     if args.checker == "oracle":
         from .models.registry import oracle_for_setup
 
@@ -90,6 +112,34 @@ def main(argv=None):
             print(f"INVARIANT {res['violation']['invariant']} VIOLATED")
             return 2
         print("no invariant violations")
+        return 0
+
+    if args.simulate is not None:
+        from .checker.simulate import Simulator
+
+        sim = Simulator(
+            setup.model,
+            invariants=setup.invariants,
+            walks=args.sim_walks,
+            max_behavior_depth=args.sim_depth,
+            seed=args.seed,
+        )
+        res = sim.run(max_behaviors=args.simulate, verbose=args.verbose)
+        print(
+            f"simulate: behaviors={res.behaviors} steps={res.steps} "
+            f"time={res.seconds:.2f}s ({res.states_per_sec:.0f} states/s)"
+        )
+        if res.violation:
+            print(
+                f"INVARIANT {res.violation.invariant} VIOLATED "
+                f"(walk {res.violation.walk}, depth {res.violation.depth})"
+            )
+            if res.trace:
+                from .utils.pprint import format_trace
+
+                print(format_trace(res.trace, setup))
+            return 2
+        print("no invariant violations (simulation is not exhaustive)")
         return 0
 
     from .checker.bfs import BFSChecker

@@ -1,0 +1,218 @@
+"""Simulation-mode checker: batched random walks on device.
+
+TLC's ``-simulate`` is the reference's prescribed fallback when brute
+force is infeasible — both ``FlexibleRaft.cfg:5`` ("State space is huge
+for this one - run with simulation") and ``KRaftWithReconfig.cfg:5``
+("too big for brute force, only simulation") demand it (SURVEY.md §4.6).
+
+TPU-native shape: R independent walks advance in lock-step as one
+device-resident [R, W] batch. Each jitted step expands all R states (the
+same vmapped successor kernel the BFS uses), samples one enabled
+candidate per walk uniformly at random, evaluates the invariants on the
+new states, and restarts deadlocked/depth-capped walks from a preloaded
+initial-state pool — all on device; only small per-walk arrays (chosen
+candidate, flags) come back to the host each step for the behavior
+journals. Initial states are invariant-checked once up front, so restart
+entry points are covered. A violating behavior replays into a labeled
+trace like the BFS checker's counterexamples.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SimViolation:
+    invariant: str
+    walk: int
+    depth: int  # steps from the behavior's start
+
+
+@dataclass
+class SimResult:
+    behaviors: int  # completed behaviors (terminal or depth-capped)
+    steps: int  # total transitions taken across all walks
+    violation: SimViolation | None
+    seconds: float
+    states_per_sec: float
+    trace: list[tuple[str, dict]] | None = None
+
+
+class Simulator:
+    def __init__(
+        self,
+        model,
+        invariants: tuple[str, ...] = (),
+        walks: int = 128,
+        max_behavior_depth: int = 50,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.invariants = tuple(invariants)
+        self.R = walks
+        self.max_behavior_depth = max_behavior_depth
+        self.seed = seed
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, states, depth, init_pool, key):
+        """One lock-step move of all R walks, fully on device.
+
+        Returns (next_states, next_depth, chosen, moved, done, restart_idx,
+        inv_bad, ovf_any); `inv_bad` is the first violated invariant's
+        index per walk (-1 = none)."""
+        model = self.model
+        R = self.R
+        succs, valid, _rank, ovf = jax.vmap(model._expand1)(states)
+        n_valid = jnp.sum(valid, axis=1)  # [R]
+        ku, kr = jax.random.split(key)
+        # uniform pick among enabled candidates: k-th enabled, k ~ U[0, n)
+        u = jax.random.uniform(ku, (R,))
+        k = jnp.floor(u * jnp.maximum(n_valid, 1)).astype(jnp.int32)
+        cum = jnp.cumsum(valid.astype(jnp.int32), axis=1)
+        chosen = jnp.argmax(cum > k[:, None], axis=1)  # first idx with cum > k
+        moved = n_valid > 0
+        nxt = jnp.where(
+            moved[:, None],
+            jnp.take_along_axis(succs, chosen[:, None, None], axis=1)[:, 0, :],
+            states,
+        )
+        ovf_any = jnp.any(
+            jnp.take_along_axis(valid & ovf, chosen[:, None], axis=1) & moved[:, None]
+        )
+        # batched invariant evaluation on the post-move states (restart
+        # targets are pre-checked initial states, see run())
+        inv_bad = jnp.full((R,), -1, jnp.int32)
+        for idx in range(len(self.invariants) - 1, -1, -1):
+            ok = self.model.invariants[self.invariants[idx]](nxt)
+            inv_bad = jnp.where(~ok & moved, jnp.int32(idx), inv_bad)
+        # restart finished behaviors (deadlock or depth bound) — TLC
+        # -simulate starts a fresh behavior; keep violating walks intact
+        new_depth = depth + moved.astype(jnp.int32)
+        done = ((~moved) | (new_depth >= self.max_behavior_depth)) & (inv_bad < 0)
+        restart_idx = jax.random.randint(kr, (R,), 0, init_pool.shape[0])
+        nxt = jnp.where(done[:, None], init_pool[restart_idx], nxt)
+        new_depth = jnp.where(done, 0, new_depth)
+        return nxt, new_depth, chosen, moved, done, restart_idx, inv_bad, ovf_any
+
+    def run(
+        self,
+        max_steps: int | None = None,
+        time_budget_s: float | None = None,
+        max_behaviors: int | None = None,
+        verbose: bool = False,
+    ) -> SimResult:
+        model = self.model
+        R = self.R
+        t0 = time.perf_counter()
+        rng = jax.random.PRNGKey(self.seed)
+
+        init = model.init_states()
+        # depth-0 check: every initial state (= every restart target)
+        for name in self.invariants:
+            ok = np.asarray(jax.device_get(model.invariants[name](init)))
+            if not ok.all():
+                return SimResult(
+                    behaviors=0,
+                    steps=0,
+                    violation=SimViolation(invariant=name, walk=0, depth=0),
+                    seconds=time.perf_counter() - t0,
+                    states_per_sec=0.0,
+                    trace=[
+                        (
+                            "Initial predicate",
+                            model.decode(init[int(np.nonzero(~ok)[0][0])]),
+                        )
+                    ],
+                )
+        init_pool = jnp.asarray(init)
+        rng, k0 = jax.random.split(rng)
+        init_idx = np.asarray(
+            jax.device_get(jax.random.randint(k0, (R,), 0, len(init)))
+        )
+        states = init_pool[jnp.asarray(init_idx)]
+        depth = jnp.zeros(R, dtype=jnp.int32)
+        # per-walk journal of (init index, chosen candidates) for replay
+        journal: list[list[int]] = [[int(i)] for i in init_idx]
+
+        behaviors = 0
+        steps = 0
+        violation = None
+
+        while violation is None:
+            if max_steps is not None and steps >= max_steps:
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
+                break
+            if max_behaviors is not None and behaviors >= max_behaviors:
+                break
+            rng, key = jax.random.split(rng)
+            states, depth, chosen, moved, done, ridx, inv_bad, ovf_any = self._step(
+                states, depth, init_pool, key
+            )
+            chosen, moved, done, ridx, inv_bad, ovf_any = jax.device_get(
+                (chosen, moved, done, ridx, inv_bad, ovf_any)
+            )
+            if bool(ovf_any):
+                raise OverflowError(
+                    "message-slot overflow during simulation: re-run with a "
+                    "larger msg_slots"
+                )
+            steps += int(moved.sum())
+            # journal bookkeeping in order: record moves, surface any
+            # violation, then reset journals of restarted walks
+            for w in np.nonzero(moved)[0]:
+                journal[w].append(int(chosen[w]))
+            bad = np.nonzero(inv_bad >= 0)[0]
+            if len(bad):
+                w = int(bad[0])
+                violation = SimViolation(
+                    invariant=self.invariants[int(inv_bad[w])],
+                    walk=w,
+                    depth=len(journal[w]) - 1,
+                )
+                break
+            for w in np.nonzero(done)[0]:
+                behaviors += 1
+                journal[w] = [int(ridx[w])]
+            if verbose and steps % (50 * R) < R:
+                el = time.perf_counter() - t0
+                print(
+                    f"simulate: {steps} steps, {behaviors} behaviors, "
+                    f"{steps/el:.0f} states/s"
+                )
+
+        dt = time.perf_counter() - t0
+        init_np = np.asarray(jax.device_get(init_pool))
+        trace = (
+            self._replay(init_np, journal[violation.walk]) if violation else None
+        )
+        return SimResult(
+            behaviors=behaviors,
+            steps=steps,
+            violation=violation,
+            seconds=dt,
+            states_per_sec=steps / dt if dt > 0 else 0.0,
+            trace=trace,
+        )
+
+    def _replay(self, init, journal: list[int]) -> list[tuple[str, dict]]:
+        """Re-run one behavior's recorded choices into a labeled trace."""
+        model = self.model
+        state = np.asarray(init[journal[0]])
+        out = [("Initial predicate", model.decode(state))]
+        for cand in journal[1:]:
+            succs, valid, rank, _ovf = jax.device_get(
+                jax.vmap(model._expand1)(state[None, :])
+            )
+            assert valid[0, cand], "journalled candidate not enabled on replay"
+            state = np.asarray(succs[0, cand])
+            out.append(
+                (model.action_label(int(rank[0, cand]), cand), model.decode(state))
+            )
+        return out

@@ -1,0 +1,116 @@
+"""The documented split-brain data-loss regression (SURVEY.md §4.7).
+
+standard-raft/README.md:86-150 walks a concrete history for the
+add/remove spec in which an administrator brings a removed server back
+blank-but-same-identity (``ResetWithSameIdentity``, enabled in
+``RaftWithReconfigAddRemove.tla:965``); a stale unreachable server then
+wins an election with the blank server's vote and becomes a newest
+leader missing an acknowledged value. This test replays that history
+step by step through the oracle (servers mapped: README s3 -> 0 [leader],
+s1 -> 1 [unreachable], s2 -> 2 [disk failure], s4 -> 3, s5 -> 4) and
+asserts that LeaderHasAllAckedValues catches it — in the oracle AND in
+the TPU invariant kernel on the encoded violating state."""
+
+import numpy as np
+
+import jax
+
+from raft_tpu.models.reconfig_raft import ReconfigRaftParams, cached_model
+from raft_tpu.oracle.reconfig_oracle import LEADER, NOTMEMBER, ReconfigRaftOracle
+
+PARAMS = ReconfigRaftParams(
+    n_servers=5, n_values=1, init_cluster_size=3, max_elections=1,
+    max_restarts=0, max_values_per_term=1, max_add_reconfigs=2,
+    max_remove_reconfigs=2, min_cluster_size=2, max_cluster_size=5,
+    msg_slots=48,
+)
+
+
+def test_add_remove_split_brain_loses_acked_value():
+    o = ReconfigRaftOracle(5, 1, 3, 1, 0, 1, 2, 2, 2, 5)
+    st = o.init_state()
+
+    def step(prefix, pick=None):
+        nonlocal st
+        for label, s2 in o.successors(st):
+            if label.startswith(prefix) and (pick is None or pick(s2)):
+                st = s2
+                return
+        raise AssertionError(f"no successor matching {prefix!r}")
+
+    # commit a client value on the initial cluster (majority {0, 2};
+    # server 1 is 'unreachable' and never receives it)
+    step("ClientRequest(0,0)")
+    step("AppendEntries(0,2)")
+    step("AcceptAppendEntriesRequest")
+    step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["acked"][0] is True
+
+    # reconfig 1a: add server 3 (README step 1), snapshot catch-up
+    step("AppendAddServerCommandToLog(0,3)")
+    step("SendSnapshot(0,3)")
+    step("UpdateTerm", pick=lambda s: s["currentTerm"][3] == 1)
+    step("HandleSnapshotRequest")
+    step("HandleSnapshotResponse")
+    step("AppendEntries(0,2)")
+    step("AcceptAppendEntriesRequest")
+    step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["config"][0] == (2, frozenset({0, 1, 2, 3}), True)
+
+    # reconfig 1b: remove the unreachable server 1 (README step 2)
+    step("AppendRemoveServerCommandToLog(0,1)")
+    for peer in (2, 3):
+        step(f"AppendEntries(0,{peer})")
+        step("AcceptAppendEntriesRequest")
+        step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["config"][0] == (3, frozenset({0, 2, 3}), True)
+
+    # reconfig 2a: add server 4 (README step 3)
+    step("AppendAddServerCommandToLog(0,4)")
+    step("SendSnapshot(0,4)")
+    step("UpdateTerm", pick=lambda s: s["currentTerm"][4] == 1)
+    step("HandleSnapshotRequest")
+    step("HandleSnapshotResponse")
+    for peer in (2, 3):
+        step(f"AppendEntries(0,{peer})")
+        step("AcceptAppendEntriesRequest")
+        step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["config"][0] == (4, frozenset({0, 2, 3, 4}), True)
+
+    # reconfig 2b: remove the failed server 2 (README step 4)
+    step("AppendRemoveServerCommandToLog(0,2)")
+    for peer in (3, 4):
+        step(f"AppendEntries(0,{peer})")
+        step("AcceptAppendEntriesRequest")
+        step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["config"][0] == (5, frozenset({0, 3, 4}), True)
+
+    # README step 5: server 2 is brought back blank with the same identity
+    step("ResetWithSameIdentity(2)")
+    assert st["state"][2] == NOTMEMBER and st["log"][2] == ()
+
+    # README step 6: the stale server 1 (still on config 1) campaigns and
+    # wins with the blank server 2's vote -> split brain
+    step("RequestVote(1)")
+    step("UpdateTerm", pick=lambda s: s["currentTerm"][2] == 2)
+    step("HandleRequestVoteRequest", pick=lambda s: s["votedFor"][2] == 1)
+    step("HandleRequestVoteResponse")
+    step("BecomeLeader(1)")
+    assert st["state"][1] == LEADER and st["state"][0] == LEADER  # split brain
+
+    # the newest leader (term 2) is missing the acknowledged value
+    assert not o.leader_has_all_acked_values(st)
+    # the TPU invariant kernel must flag the same state
+    model = cached_model(PARAMS)
+    vec = model.encode(st)[None, :]
+    ok = np.asarray(
+        jax.device_get(model.invariants["LeaderHasAllAckedValues"](vec))
+    )
+    assert not ok[0]
+    # sanity: the state also still diverges nowhere below common commit
+    assert o.no_log_divergence(st)
