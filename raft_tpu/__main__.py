@@ -89,13 +89,38 @@ def main(argv=None):
         f"symmetry={symmetry} checker={args.checker}"
     )
 
-    if args.checker == "oracle" and args.simulate is not None:
+    if args.checker == "tpu" and not hasattr(setup.model, "expand"):
         print(
-            "error: --simulate requires the tpu checker (the oracle backend "
-            "is exhaustive-only)",
+            f"error: spec {setup.model.name} has no TPU lowering yet; use "
+            "--checker oracle (exhaustive or --simulate)",
             file=sys.stderr,
         )
         return 64
+
+    if args.checker == "oracle" and args.simulate is not None:
+        from .models.registry import oracle_for_setup
+
+        oracle = oracle_for_setup(setup)
+        if not hasattr(oracle, "simulate"):
+            print(
+                "error: --simulate with the oracle backend is only "
+                "supported for specs whose oracle implements it; use the "
+                "tpu checker's --simulate instead",
+                file=sys.stderr,
+            )
+            return 64
+        res = oracle.simulate(
+            invariants=setup.invariants,
+            behaviors=args.simulate,
+            max_depth=args.sim_depth,
+            seed=args.seed,
+        )
+        print(f"simulate: behaviors={res['behaviors']} steps={res['steps']}")
+        if res["violation"]:
+            print(f"INVARIANT {res['violation']['invariant']} VIOLATED")
+            return 2
+        print("no invariant violations (simulation is not exhaustive)")
+        return 0
 
     if args.checker == "oracle":
         from .models.registry import oracle_for_setup

@@ -1,0 +1,65 @@
+"""KRaftWithReconfig checker parameters + backend dispatch.
+
+Reference: ``/root/reference/specifications/pull-raft/
+KRaftWithReconfig.tla`` (1,918 lines) — the dynamic-server-universe spec.
+The full semantics are implemented in
+``oracle/kraft_reconfig_oracle.py`` (the CHECKER=oracle backend and the
+spec's own prescribed simulation mode, ``KRaftWithReconfig.cfg:5`` "too
+big for brute force, only simulation").
+
+The vectorized TPU lowering needs fixed identity slots (MaxSpawnedServers
+many, with an alive mask — SURVEY.md §7.2 "dynamic server universe") plus
+a data-dependent symmetry canonicalization (host permutations re-sort the
+slot table), and lands as its own milestone; until then the registry
+entry dispatches this spec to the oracle backends and reports a clear
+error for the device BFS path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class KRaftReconfigParams:
+    n_hosts: int
+    n_values: int
+    init_cluster_size: int
+    min_cluster_size: int
+    max_cluster_size: int
+    max_elections: int
+    max_restarts: int
+    max_values_per_epoch: int
+    max_add_reconfigs: int
+    max_remove_reconfigs: int
+    max_spawned_servers: int
+
+
+class KRaftReconfigSpec:
+    """Backendless spec descriptor: names + invariant table for cfg
+    validation; the oracle carries the executable semantics."""
+
+    name = "KRaftWithReconfig"
+
+    INVARIANT_NAMES = (
+        "NoIllegalState",
+        "NoLogDivergence",
+        "StatesMatchRoles",
+        "NeverTwoLeadersInSameEpoch",
+        "LeaderHasAllAckedValues",
+        "MessagesAreValid",
+        "TestInv",
+    )
+
+    def __init__(self, params: KRaftReconfigParams, server_names=None,
+                 value_names=None):
+        self.p = params
+        self.server_names = list(
+            server_names or [f"h{i+1}" for i in range(params.n_hosts)]
+        )
+        self.value_names = list(
+            value_names or [f"v{i+1}" for i in range(params.n_values)]
+        )
+        # dict-shaped like the device models' invariant tables so the
+        # registry's unknown-invariant check works unchanged
+        self.invariants = {n: None for n in self.INVARIANT_NAMES}

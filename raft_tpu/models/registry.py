@@ -274,6 +274,39 @@ def build_reconfig_joint(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     )
 
 
+def build_kraft_reconfig(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+    """pull-raft/KRaftWithReconfig.tla + its cfg: the dynamic-server
+    universe spec (oracle + simulation backends; its cfg prescribes
+    simulation, KRaftWithReconfig.cfg:5). The cfg shares PullRaft.cfg's
+    latent bug: Value = {v1, v2} with v2 undeclared (lenient repairs)."""
+    from .kraft_reconfig import KRaftReconfigParams, KRaftReconfigSpec
+
+    hosts = cfg.server_like("Hosts")
+    values = cfg.server_like("Value")
+    params = KRaftReconfigParams(
+        n_hosts=len(hosts),
+        n_values=len(values),
+        init_cluster_size=_require_int(cfg, "InitClusterSize"),
+        min_cluster_size=_require_int(cfg, "MinClusterSize"),
+        max_cluster_size=_require_int(cfg, "MaxClusterSize"),
+        max_elections=_require_int(cfg, "MaxElections"),
+        max_restarts=_require_int(cfg, "MaxRestarts"),
+        max_values_per_epoch=_require_int(cfg, "MaxValuesPerEpoch"),
+        max_add_reconfigs=_require_int(cfg, "MaxAddReconfigs"),
+        max_remove_reconfigs=_require_int(cfg, "MaxRemoveReconfigs"),
+        max_spawned_servers=_require_int(cfg, "MaxSpawnedServers"),
+    )
+    model = KRaftReconfigSpec(params, server_names=hosts, value_names=values)
+    _check_invariants(cfg, model)
+    return CheckSetup(
+        model=model,
+        invariants=tuple(cfg.invariants),
+        symmetry=cfg.symmetry is not None,
+        server_names=hosts,
+        value_names=values,
+    )
+
+
 BUILDERS = {
     "Raft": build_raft,
     "FlexibleRaft": build_flexible_raft,
@@ -283,6 +316,7 @@ BUILDERS = {
     "KRaft": build_kraft,
     "RaftWithReconfigAddRemove": build_reconfig_add_remove,
     "RaftWithReconfigJointConsensus": build_reconfig_joint,
+    "KRaftWithReconfig": build_kraft_reconfig,
 }
 
 
@@ -310,6 +344,17 @@ def oracle_for_setup(setup: CheckSetup):
             p.max_restarts, p.max_values_per_term, p.max_add_reconfigs,
             p.max_remove_reconfigs, p.min_cluster_size, p.max_cluster_size,
             include_thesis_bug=p.include_thesis_bug,
+        )
+    from .kraft_reconfig import KRaftReconfigParams
+
+    if isinstance(p, KRaftReconfigParams):
+        from ..oracle.kraft_reconfig_oracle import KRaftReconfigOracle
+
+        return KRaftReconfigOracle(
+            p.n_hosts, p.n_values, p.init_cluster_size, p.min_cluster_size,
+            p.max_cluster_size, p.max_elections, p.max_restarts,
+            p.max_values_per_epoch, p.max_add_reconfigs,
+            p.max_remove_reconfigs, p.max_spawned_servers,
         )
     from .joint_raft import JointRaftParams
 

@@ -1,0 +1,178 @@
+"""KRaftWithReconfig oracle tests: join/remove reconfiguration flows over
+the dynamic server universe (pull-raft/KRaftWithReconfig.tla, 1,918
+lines), invariants, bounded BFS sanity, simulation mode, and
+reference-cfg loading with the documented v2 repair."""
+
+import pytest
+
+from raft_tpu.oracle.kraft_reconfig_oracle import (
+    FOLLOWER,
+    LEADER,
+    OBSERVER,
+    RESIGNED,
+    UNATTACHED,
+    VOTER,
+    KRaftReconfigOracle,
+)
+
+
+def small_oracle(**kw) -> KRaftReconfigOracle:
+    defaults = dict(
+        n_hosts=3, n_values=1, init_cluster_size=2, min_cluster_size=2,
+        max_cluster_size=3, max_elections=1, max_restarts=1,
+        max_values_per_epoch=1, max_add_reconfigs=1, max_remove_reconfigs=1,
+        max_spawned_servers=4,
+    )
+    defaults.update(kw)
+    return KRaftReconfigOracle(**defaults)
+
+
+def step(o, st, prefix, pick=None):
+    for label, s2 in o.successors(st):
+        if label.startswith(prefix) and (pick is None or pick(s2)):
+            return s2
+    raise AssertionError(f"no successor matching {prefix!r}")
+
+
+def test_init_state_shape():
+    o = small_oracle()
+    st = o.init_state()
+    assert st["servers"] == frozenset({(0, 0), (1, 0)})
+    leader = (0, 0)
+    assert st["state"][leader] == LEADER
+    assert st["role"][leader] == VOTER
+    assert st["highWatermark"][leader] == 1
+    assert all(o.INVARIANTS[n](o, st) for n in o.INVARIANTS)
+
+
+def test_join_flow_new_server_becomes_voter():
+    """StartNewServer -> observer fetch catch-up -> SendJoinRequest ->
+    AcceptJoinRequest -> AddServerCommand replication -> role flip
+    (:1492-1590, MaybeSwitchConfigurations :753-771)."""
+    o = small_oracle()
+    st = o.init_state()
+    leader = (0, 0)
+    # a new server starts on host 2 with diskId 1, fetching from the leader
+    st = step(o, st, "StartNewServer(2,")
+    new_id = (2, 1)
+    assert new_id in st["servers"]
+    assert st["role"][new_id] == OBSERVER
+    assert st["state"][new_id] == UNATTACHED
+    # leader accepts the observer's first fetch (epoch 0 < leader's 1 ->
+    # rejected with FencedLeaderEpoch... actually mepoch=0 < 1 -> Reject)
+    st = step(o, st, "RejectFetchRequest")
+    st = step(o, st, "HandleNonSuccessFetchResponse")
+    # after learning the leader+epoch, fetch catch-up
+    assert st["leader"][new_id] == leader
+    assert st["state"][new_id] == FOLLOWER
+    st = step(o, st, f"SendFetchRequest({new_id},{leader})")
+    st = step(o, st, "AcceptFetchRequestFromObserver")
+    st = step(o, st, "HandleSuccessFetchResponse")
+    assert len(st["log"][new_id]) == 1  # got the InitClusterCommand
+    # join
+    st = step(o, st, f"SendJoinRequest({new_id},{leader})")
+    st = step(o, st, "AcceptJoinRequest")
+    assert st["config"][leader][1] == frozenset({(0, 0), (1, 0), new_id})
+    assert st["config"][leader][2] is False  # uncommitted
+    # replicate the AddServerCommand to the new member
+    st = step(o, st, f"SendFetchRequest({new_id},{leader})")
+    st = step(o, st, "AcceptFetchRequestFromObserver")
+    st = step(o, st, "HandleSuccessFetchResponse")
+    # the new server sees itself in the config -> becomes Voter
+    assert st["role"][new_id] == VOTER
+    assert st["state"][new_id] == FOLLOWER
+    # commit via voter fetches from the original follower: the first
+    # ships the AddServerCommand, the second advances endOffset to 2
+    st = step(o, st, f"SendFetchRequest({(1, 0)},{leader})")
+    st = step(o, st, "AcceptFetchRequestFromVoter")
+    st = step(o, st, "HandleSuccessFetchResponse")
+    st = step(o, st, f"SendFetchRequest({(1, 0)},{leader})")
+    st = step(o, st, "AcceptFetchRequestFromVoter")
+    assert st["highWatermark"][leader] == 2
+    assert st["config"][leader][2] is True
+    assert all(o.INVARIANTS[n](o, st) for n in o.INVARIANTS)
+
+
+def test_remove_leader_resigns_on_commit():
+    """A leader that removes itself becomes an observer immediately
+    (:1717-1719) and resigns once the command commits
+    (:1317-1324): Unattached observer with hwm 0."""
+    o = small_oracle(init_cluster_size=3, max_cluster_size=3)
+    st = o.init_state()
+    leader = (0, 0)
+    st = step(o, st, f"HandleRemoveRequest({leader},{leader})")
+    assert st["role"][leader] == OBSERVER
+    assert st["state"][leader] == LEADER  # still acting leader
+    members = st["config"][leader][1]
+    assert leader not in members
+    # replicate to both remaining voters; their endOffsets alone must
+    # commit (leader excluded from the quorum, :1271-1274)
+    for peer in ((1, 0), (2, 0)):
+        st = step(o, st, f"SendFetchRequest({peer},{leader})")
+        st = step(o, st, "AcceptFetchRequestFromVoter")
+        st = step(o, st, "HandleSuccessFetchResponse")
+    for peer in ((1, 0), (2, 0)):
+        st = step(o, st, f"SendFetchRequest({peer},{leader})")
+        st = step(o, st, "AcceptFetchRequestFromVoter")
+    # the commit of its own removal made the leader resign
+    assert st["state"][leader] == UNATTACHED
+    assert st["role"][leader] == OBSERVER
+    assert st["highWatermark"][leader] == 0
+    assert all(o.INVARIANTS[n](o, st) for n in o.INVARIANTS)
+
+
+def test_restart_with_state_leader_resigns():
+    o = small_oracle()
+    st = o.init_state()
+    st = step(o, st, "RestartWithState((0, 0))")
+    assert st["state"][(0, 0)] == RESIGNED
+    assert st["leader"][(0, 0)] is None
+    assert st["highWatermark"][(0, 0)] == 0
+    assert len(st["log"][(0, 0)]) == 1  # log survives
+
+
+def test_bounded_bfs_holds_invariants():
+    o = small_oracle()
+    res = o.bfs(symmetry=True, max_depth=3)
+    assert res["violation"] is None
+    assert res["distinct"] > 20
+    # symmetry reduces the distinct count
+    res_nosym = o.bfs(symmetry=False, max_depth=3)
+    assert res_nosym["violation"] is None
+    assert res_nosym["distinct"] >= res["distinct"]
+
+
+def test_simulation_mode_runs_clean():
+    o = small_oracle()
+    res = o.simulate(behaviors=12, max_depth=12, seed=5)
+    assert res["violation"] is None
+    assert res["steps"] > 60
+
+
+def test_reference_cfg_loads_with_v2_repair():
+    from raft_tpu.utils.cfg import CfgError, parse_cfg
+    from raft_tpu.models.registry import build_from_cfg, oracle_for_setup
+
+    path = "/root/reference/specifications/pull-raft/KRaftWithReconfig.cfg"
+    with pytest.raises(CfgError, match="undeclared model value 'v2'"):
+        parse_cfg(path)
+    cfg = parse_cfg(path, lenient=True)
+    setup = build_from_cfg(cfg)
+    assert setup.model.name == "KRaftWithReconfig"
+    assert setup.model.p.n_hosts == 3
+    assert setup.model.p.n_values == 2  # after repair
+    assert setup.model.p.max_spawned_servers == 5
+    assert setup.invariants == (
+        "LeaderHasAllAckedValues",
+        "NoLogDivergence",
+        "NeverTwoLeadersInSameEpoch",
+        "NoIllegalState",
+        "StatesMatchRoles",
+    )
+    assert setup.symmetry
+    oracle = oracle_for_setup(setup)
+    # drive a few simulated behaviors on the real cfg constants
+    res = oracle.simulate(
+        invariants=setup.invariants, behaviors=4, max_depth=10, seed=1
+    )
+    assert res["violation"] is None
