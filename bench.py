@@ -29,11 +29,35 @@ def tpu_rate() -> tuple[float, dict]:
     from raft_tpu.models.registry import build_from_cfg
     from raft_tpu.checker.bfs import BFSChecker
 
+    import numpy as np
+
     cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
     setup = build_from_cfg(cfg, msg_slots=32)
+    chunk = int(os.environ.get("BENCH_CHUNK", "2048"))
     checker = BFSChecker(
-        setup.model, invariants=setup.invariants, symmetry=True, chunk=2048
+        setup.model, invariants=setup.invariants, symmetry=True, chunk=chunk
     )
+    # warm-up: compile the expansion / fingerprint / invariant kernels at
+    # the exact shapes the BFS loop uses, so the recorded rate is the
+    # sustained throughput (first TPU compile is ~20-40 s and would
+    # otherwise dominate a short budget)
+    model = setup.model
+    init = model.init_states()
+    batch = np.repeat(init, chunk, axis=0)
+    succs, valid, _rank, _ovf = model.expand(batch)
+    flat = succs.reshape(-1, model.layout.W)
+    checker.canon.fingerprints(flat).block_until_ready()
+    checker.canon.fingerprints(init).block_until_ready()  # run()'s init call
+    # invariant batches are power-of-two bucketed by the checker; warm the
+    # buckets a depth-capped Raft.cfg run actually visits
+    size = 1
+    while size <= chunk * 8:
+        model.invariants[setup.invariants[0]](
+            np.repeat(init, size, axis=0)
+        ).block_until_ready()
+        for name in setup.invariants[1:]:
+            model.invariants[name](np.repeat(init, size, axis=0)).block_until_ready()
+        size *= 2
     budget = float(os.environ["BENCH_TIME_BUDGET_S"])
     max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "0")) or None
     t0 = time.perf_counter()

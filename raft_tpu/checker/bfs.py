@@ -216,10 +216,22 @@ class BFSChecker:
 
     def _check_invariants(self, states: np.ndarray, base_gid: int, depth: int):
         """Batched invariant evaluation; returns the first (in exploration
-        order) violation, matching TLC's report-first-found behavior."""
+        order) violation, matching TLC's report-first-found behavior.
+
+        Wave sizes vary every depth, so the batch is padded to the next
+        power of two: jit caches per shape, and without bucketing every
+        wave recompiles the invariant kernels (a real cost on TPU)."""
+        n = len(states)
+        if n == 0:
+            return None
+        m = 1 << (n - 1).bit_length()
+        if m > n:  # pad with copies of a real state; slice them off below
+            states = np.concatenate(
+                [states, np.repeat(states[:1], m - n, axis=0)], axis=0
+            )
         for name in self.invariants:
             ok = np.asarray(jax.device_get(self.model.invariants[name](states)))
-            bad = np.nonzero(~ok)[0]
+            bad = np.nonzero(~ok[:n])[0]
             if len(bad):
                 return Violation(invariant=name, global_id=base_gid + int(bad[0]), depth=depth)
         return None
