@@ -22,9 +22,19 @@ def main(argv=None):
     ap.add_argument(
         "--checker",
         default=os.environ.get("CHECKER", "tpu"),
-        choices=["tpu", "oracle"],
-        help="backend: tpu (JAX device BFS) or oracle (pure-Python reference)",
+        choices=["tpu", "tpu-host", "oracle"],
+        help="backend: tpu (device-resident BFS), tpu-host (device "
+        "expansion + host dedup, the v1 driver), or oracle (pure-Python "
+        "reference)",
     )
+    ap.add_argument("--frontier-cap", type=int, default=None,
+                    help="device frontier buffer rows (tpu checker)")
+    ap.add_argument("--seen-cap", type=int, default=None,
+                    help="device seen-set capacity (tpu checker)")
+    ap.add_argument("--journal-cap", type=int, default=None,
+                    help="device trace-journal capacity (tpu checker)")
+    ap.add_argument("--time-budget", type=float, default=None,
+                    help="stop (non-exhausted) after this many seconds")
     ap.add_argument("--max-depth", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
     ap.add_argument(
@@ -89,7 +99,7 @@ def main(argv=None):
         f"symmetry={symmetry} checker={args.checker}"
     )
 
-    if args.checker == "tpu" and not hasattr(setup.model, "expand"):
+    if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
         print(
             f"error: spec {setup.model.name} has no TPU lowering yet; use "
             "--checker oracle (exhaustive or --simulate)",
@@ -167,15 +177,39 @@ def main(argv=None):
         print("no invariant violations (simulation is not exhaustive)")
         return 0
 
-    from .checker.bfs import BFSChecker
+    if args.checker == "tpu":
+        from .checker.device_bfs import DeviceBFS
 
-    checker = BFSChecker(
-        setup.model,
-        invariants=setup.invariants,
-        symmetry=symmetry,
-        chunk=args.chunk,
+        caps = {
+            k: v
+            for k, v in {
+                "frontier_cap": args.frontier_cap,
+                "seen_cap": args.seen_cap,
+                "journal_cap": args.journal_cap,
+            }.items()
+            if v is not None
+        }
+        checker = DeviceBFS(
+            setup.model,
+            invariants=setup.invariants,
+            symmetry=symmetry,
+            chunk=args.chunk,
+            **caps,
+        )
+    else:
+        from .checker.bfs import BFSChecker
+
+        checker = BFSChecker(
+            setup.model,
+            invariants=setup.invariants,
+            symmetry=symmetry,
+            chunk=args.chunk,
+        )
+    res = checker.run(
+        max_depth=args.max_depth,
+        verbose=args.verbose,
+        time_budget_s=args.time_budget,
     )
-    res = checker.run(max_depth=args.max_depth, verbose=args.verbose)
     print(
         f"distinct={res.distinct} total={res.total} depth={res.depth} "
         f"terminal={res.terminal} time={res.seconds:.2f}s "

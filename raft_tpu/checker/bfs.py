@@ -62,6 +62,7 @@ class CheckResult:
     states_per_sec: float
     exhausted: bool = True  # False if stopped by max_depth/time budget
     trace: list[tuple[str, dict]] | None = None  # (action label, decoded state)
+    metrics: list[dict] | None = None  # per-wave metrics (SURVEY.md §5.5)
 
 
 class BFSChecker:

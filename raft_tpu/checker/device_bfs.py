@@ -1,0 +1,372 @@
+"""Device-resident BFS — the fast path of the TPU checker.
+
+Same exploration semantics as checker/bfs.py (the host-dedup v1 driver):
+identical distinct sets, gid numbering, first-occurrence tie-breaking and
+violation reporting — but the whole hot loop lives in HBM. Per wave the
+host transfers only a handful of scalars; states never round-trip.
+
+Pipeline per chunk (one jitted program, all device):
+  1. expand `chunk` frontier states (vmap over the per-action kernels)
+  2. compact the valid successor lanes (typically <20% of chunk*A) so
+     canonicalization/hashing only runs on real candidates
+  3. canonical fingerprints (VIEW + SYMMETRY, ops/symmetry.py)
+  4. dedup: probe the sorted device-resident seen-set + the in-wave
+     fingerprint buffer (searchsorted), first-occurrence within the chunk
+  5. scatter survivors into the device next-frontier buffer and their
+     (parent gid, candidate) rows into the device journal
+  6. evaluate invariants on the compacted candidates, folding the first
+     violating gid per invariant into a device accumulator
+
+Per wave a second jitted program merges the wave's fingerprints into the
+seen-set (sorted-array union). The journal is fetched to the host only
+when a violation needs a counterexample trace (or for checkpointing).
+
+This replaces TLC's shared fingerprint set + BFS queue (SURVEY.md §3.1
+hot loop); `-deadlock` semantics are preserved (terminal states counted,
+not errors, reference README.md:7).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.hashing import U64_MAX
+from ..ops.symmetry import Canonicalizer
+from .bfs import CheckResult, Violation
+
+I32_MAX = np.int32(2**31 - 1)
+
+
+def _probe(sorted_arr, vals):
+    """Membership of vals in a sorted u64 array padded with U64_MAX."""
+    pos = jnp.searchsorted(sorted_arr, vals)
+    pos = jnp.clip(pos, 0, sorted_arr.shape[0] - 1)
+    return sorted_arr[pos] == vals
+
+
+class DeviceBFS:
+    """Single-device BFS with device-resident frontier/seen-set/journal.
+
+    Capacities are static (XLA shapes); every one is guarded by an
+    overflow flag that aborts the run rather than dropping states:
+      frontier_cap   per-wave distinct states (frontier buffer rows)
+      seen_cap       total distinct states (sorted fingerprint array)
+      journal_cap    total distinct states beyond Init (trace journal)
+      valid_per_state  compaction budget: avg valid successors per state
+                       (Raft-family specs average ~5 of A~53; 16 is
+                       generous, overflow-checked)
+    """
+
+    def __init__(
+        self,
+        model,
+        invariants: tuple[str, ...] = (),
+        symmetry: bool = True,
+        chunk: int = 1024,
+        frontier_cap: int = 1 << 18,
+        seen_cap: int = 1 << 22,
+        journal_cap: int = 1 << 22,
+        valid_per_state: int = 16,
+        check_deadlock: bool = False,
+    ):
+        self.model = model
+        self.invariants = tuple(invariants)
+        self.chunk = chunk
+        self.check_deadlock = check_deadlock
+        self.A = model.A
+        self.W = model.layout.W
+        self.FCAP = frontier_cap
+        self.SCAP = seen_cap
+        self.JCAP = journal_cap
+        self.VC = min(chunk * self.A, chunk * valid_per_state)
+        assert chunk <= frontier_cap
+        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
+        # donated: next_buf, wave_fps, jparent, jcand, viol, stats
+        self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=(2, 3, 4, 5, 6, 7))
+        self._finalize_fn = jax.jit(self._finalize, donate_argnums=(0, 1, 2))
+        self._init_distinct: np.ndarray | None = None
+        self._jparent = None
+        self._jcand = None
+        self._jcount = 0
+
+    # ---------------- device programs ----------------
+
+    def _chunk_step(
+        self, frontier, seen, next_buf, wave_fps, jparent, jcand, viol, stats,
+        cursor, fcount, base_gid,
+    ):
+        """One chunk of the current wave. stats is i64[5]:
+        [wave new count, journal count, cumulative generated,
+         cumulative terminal, overflow bits]."""
+        model = self.model
+        C, A, W, VC = self.chunk, self.A, self.W, self.VC
+        FCAP, JCAP = self.FCAP, self.JCAP
+
+        batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
+        live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
+        succs, valid, _rank, ovf = jax.vmap(model._expand1)(batch)
+        valid = valid & live[:, None]
+        expand_ovf = jnp.any(valid & ovf)
+        n_gen = jnp.sum(valid)
+        terminal = jnp.sum(live & ~jnp.any(valid, axis=1))
+
+        # 2. compact valid lanes: sel[j] = flat lane of the j-th valid succ
+        vflat = valid.reshape(-1)
+        vpos = jnp.cumsum(vflat) - 1
+        compact_ovf = n_gen > VC
+        sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+        sel = (
+            jnp.full((VC + 1,), C * A, jnp.int32)
+            .at[sdst]
+            .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+        )
+        selv = sel < C * A
+        flatp = jnp.concatenate(
+            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
+        )
+        flatc = flatp[sel]  # [VC, W]
+
+        # 3. canonical fingerprints on compacted lanes only
+        fps = self.canon._fingerprints(flatc)
+        fps = jnp.where(selv, fps, U64_MAX)
+
+        # 4. dedup (seen-set, in-wave buffer, first-occurrence in chunk)
+        fresh = ~_probe(seen, fps) & ~_probe(wave_fps, fps) & (fps != U64_MAX)
+        order = jnp.argsort(fps, stable=True)
+        rf = fps[order]
+        first_s = jnp.ones((VC,), bool).at[1:].set(rf[1:] != rf[:-1])
+        first = jnp.zeros((VC,), bool).at[order].set(first_s)
+        new = fresh & first
+        n_new = jnp.sum(new)
+
+        # 5. scatter into next frontier + journal (row FCAP/JCAP = drop lane)
+        ncount = stats[0].astype(jnp.int32)
+        jcount = stats[1].astype(jnp.int32)
+        npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
+        frontier_ovf = ncount + n_new > FCAP
+        bdst = jnp.where(new, jnp.minimum(ncount + npos, FCAP), FCAP)
+        next_buf = next_buf.at[bdst].set(flatc)
+        journal_ovf = jcount + n_new > JCAP
+        jdst = jnp.where(new, jnp.minimum(jcount + npos, JCAP), JCAP)
+        jparent = jparent.at[jdst].set(base_gid + cursor + sel // A)
+        jcand = jcand.at[jdst].set(sel % A)
+        wave_fps = jnp.sort(
+            jnp.concatenate([wave_fps, jnp.where(new, fps, U64_MAX)])
+        )[: FCAP + 1]
+
+        # 6. invariants on the compacted candidates; fold first-bad gid
+        jidx = jnp.where(new, jcount + npos, I32_MAX)
+        for k, name in enumerate(self.invariants):
+            ok = model.invariants[name](flatc)
+            bad = new & ~ok
+            viol = viol.at[k].min(jnp.min(jnp.where(bad, jidx, I32_MAX)))
+
+        ovf_bits = (
+            expand_ovf.astype(jnp.int64)
+            + 2 * compact_ovf.astype(jnp.int64)
+            + 4 * frontier_ovf.astype(jnp.int64)
+            + 8 * journal_ovf.astype(jnp.int64)
+        )
+        stats = jnp.stack(
+            [
+                stats[0] + n_new,
+                stats[1] + n_new,
+                stats[2] + n_gen,
+                stats[3] + terminal,
+                stats[4] | ovf_bits,
+            ]
+        )
+        return next_buf, wave_fps, jparent, jcand, viol, stats
+
+    def _finalize(self, seen, wave_fps, stats):
+        """End of wave: union the wave fingerprints into the seen-set and
+        reset the wave buffer + wave counter."""
+        merged = jnp.sort(jnp.concatenate([seen, wave_fps]))[: self.SCAP]
+        fresh = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
+        stats = stats.at[0].set(0)
+        return merged, fresh, stats
+
+    # ---------------- host driver ----------------
+
+    def run(
+        self,
+        max_depth: int | None = None,
+        verbose: bool = False,
+        time_budget_s: float | None = None,
+        collect_metrics: bool = False,
+    ) -> CheckResult:
+        model = self.model
+        C, W, FCAP = self.chunk, self.W, self.FCAP
+        t0 = time.perf_counter()
+        exhausted = True
+
+        init = model.init_states()
+        init_fps = np.asarray(
+            jax.device_get(self.canon.fingerprints(init)), dtype=np.uint64
+        )
+        order = np.argsort(init_fps, kind="stable")
+        keep = np.ones(len(order), dtype=bool)
+        sf = init_fps[order]
+        dup = np.zeros(len(order), dtype=bool)
+        dup[1:] = sf[1:] == sf[:-1]
+        keep[order[dup]] = False
+        init_d = np.asarray(init[keep])
+        n0 = len(init_d)
+        assert n0 <= FCAP, "initial states exceed frontier_cap"
+        self._init_distinct = init_d
+
+        violation = self._check_init(init_d)
+
+        seen_h = np.full(self.SCAP, np.uint64(U64_MAX), dtype=np.uint64)
+        seen_h[:n0] = np.sort(init_fps[keep])
+        seen_h.sort()
+        frontier_h = np.zeros((FCAP + 1, W), dtype=np.int32)
+        frontier_h[:n0] = init_d
+
+        frontier = jnp.asarray(frontier_h)
+        next_buf = jnp.zeros((FCAP + 1, W), jnp.int32)
+        seen = jnp.asarray(seen_h)
+        wave_fps = jnp.full((FCAP + 1,), U64_MAX, jnp.uint64)
+        jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
+        jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
+        viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
+        stats = jnp.zeros((5,), jnp.int64)
+
+        fcount = n0
+        scount = n0
+        distinct = n0
+        total = n0
+        terminal = 0
+        depth = 0
+        base_gid = 0
+        depth_counts = [n0]
+        gen_prev = 0
+        metrics: list[dict] | None = [] if collect_metrics else None
+
+        while fcount and violation is None:
+            if max_depth is not None and depth >= max_depth:
+                exhausted = False
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
+                exhausted = False
+                break
+            tw = time.perf_counter()
+            for cursor in range(0, fcount, C):
+                next_buf, wave_fps, jparent, jcand, viol, stats = self._chunk_fn(
+                    frontier, seen, next_buf, wave_fps, jparent, jcand, viol,
+                    stats, np.int32(cursor), np.int32(fcount), np.int32(base_gid),
+                )
+            stats_h = np.asarray(jax.device_get(stats))
+            ncount = int(stats_h[0])
+            ovf_bits = int(stats_h[4])
+            if ovf_bits:
+                raise OverflowError(
+                    f"device BFS capacity overflow (bits={ovf_bits:04b}: "
+                    "1=msg-slots 2=valid_per_state 4=frontier_cap 8=journal_cap)"
+                )
+            n_gen = int(stats_h[2])
+            wave_gen = n_gen - gen_prev
+            total += wave_gen
+            gen_prev = n_gen
+            terminal = int(stats_h[3])
+            if ncount == 0:
+                break
+            scount += ncount
+            if scount > self.SCAP:
+                raise OverflowError("seen-set capacity overflow; raise seen_cap")
+            depth += 1
+            distinct += ncount
+            depth_counts.append(ncount)
+            if self.invariants:
+                viol_h = np.asarray(jax.device_get(viol))
+                for k, name in enumerate(self.invariants):
+                    if viol_h[k] != I32_MAX:
+                        violation = Violation(
+                            invariant=name, global_id=n0 + int(viol_h[k]), depth=depth
+                        )
+                        break
+            base_gid = n0 + int(stats_h[1]) - ncount
+            seen, wave_fps, stats = self._finalize_fn(seen, wave_fps, stats)
+            frontier, next_buf = next_buf, frontier
+            prev_fcount = fcount
+            fcount = ncount
+            if metrics is not None or verbose:
+                el = time.perf_counter() - t0
+                wm = {
+                    "depth": depth,
+                    "frontier": prev_fcount,
+                    "new": ncount,
+                    "generated": wave_gen,
+                    "dedup_hit_rate": round(1.0 - ncount / max(1, wave_gen), 4),
+                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "distinct_per_s": round(distinct / el, 1),
+                }
+                if metrics is not None:
+                    metrics.append(wm)
+                if verbose:
+                    print(
+                        f"depth {depth}: frontier {ncount}, distinct {distinct}, "
+                        f"total {total}, {distinct/el:.0f} distinct/s"
+                    )
+
+        self._jparent = jparent
+        self._jcand = jcand
+        self._jcount = int(np.asarray(jax.device_get(stats))[1])
+
+        dt = time.perf_counter() - t0
+        trace = self.reconstruct_trace(violation) if violation else None
+        res = CheckResult(
+            distinct=distinct,
+            total=total,
+            depth=depth,
+            depth_counts=depth_counts,
+            violation=violation,
+            terminal=terminal,
+            seconds=dt,
+            states_per_sec=distinct / dt if dt > 0 else 0.0,
+            exhausted=exhausted and violation is None,
+            trace=trace,
+            metrics=metrics,
+        )
+        return res
+
+    def _check_init(self, init_d: np.ndarray) -> Violation | None:
+        for name in self.invariants:
+            ok = np.asarray(jax.device_get(self.model.invariants[name](init_d)))
+            bad = np.nonzero(~ok)[0]
+            if len(bad):
+                return Violation(invariant=name, global_id=int(bad[0]), depth=0)
+        return None
+
+    # ---------------- trace reconstruction ----------------
+
+    def reconstruct_trace(self, violation: Violation) -> list[tuple[str, dict]]:
+        """Parent-pointer replay, identical semantics to BFSChecker's
+        (journal is flat (parent gid, candidate) arrays here)."""
+        model = self.model
+        n0 = len(self._init_distinct)
+        jc_n = self._jcount
+        jp = np.asarray(jax.device_get(self._jparent))[:jc_n]
+        jc = np.asarray(jax.device_get(self._jcand))[:jc_n]
+        chain: list[int] = []
+        gid = violation.global_id
+        while gid >= n0:
+            chain.append(int(jc[gid - n0]))
+            gid = int(jp[gid - n0])
+        chain.reverse()
+        state = self._init_distinct[gid]
+        out = [("Initial predicate", model.decode(state))]
+        expand1 = jax.jit(model._expand1)
+        for cand in chain:
+            succs, valid, rank, _ovf = jax.device_get(expand1(state))
+            assert valid[cand], "journalled candidate not enabled on replay"
+            state = np.asarray(succs[cand])
+            out.append(
+                (model.action_label(int(rank[cand]), cand), model.decode(state))
+            )
+        return out
