@@ -137,7 +137,10 @@ def main(argv=None):
 
         oracle = oracle_for_setup(setup)  # carries all variant knobs
         res = oracle.bfs(
-            invariants=setup.invariants, symmetry=symmetry, max_depth=args.max_depth
+            invariants=setup.invariants,
+            symmetry=symmetry,
+            max_depth=args.max_depth,
+            time_budget_s=args.time_budget,
         )
         print(
             f"distinct={res['distinct']} total={res['total']} "

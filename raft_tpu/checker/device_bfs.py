@@ -85,6 +85,11 @@ class DeviceBFS:
         self.JCAP = journal_cap
         self.VC = min(chunk * self.A, chunk * valid_per_state)
         assert chunk <= frontier_cap
+        # the per-chunk dynamic_slice would clamp an out-of-bounds start and
+        # silently re-expand earlier rows (while `live` still used the
+        # unclamped cursor, skipping tail states); requiring divisibility
+        # keeps every slice in bounds
+        assert frontier_cap % chunk == 0, "frontier_cap must be a multiple of chunk"
         self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         # donated: next_buf, wave_fps, jparent, jcand, viol, stats
         self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=(2, 3, 4, 5, 6, 7))
@@ -240,7 +245,7 @@ class DeviceBFS:
         fcount = n0
         scount = n0
         distinct = n0
-        total = n0
+        total = len(init)  # pre-dedup, matching BFSChecker's seeding
         terminal = 0
         depth = 0
         base_gid = 0

@@ -49,8 +49,19 @@ def wide_bag_put(words, cnt, key):
     is_empty = words[0] == EMPTY
     slot = jnp.argmax(is_empty)  # empties are sorted last; any empty works
     have_empty = is_empty.any()
-    ins = [w.at[slot].set(k) for w, k in zip(words, key)]
-    cnt_ins = cnt.at[slot].set(jnp.int32(1))
+    # one-hot select instead of `.at[slot].set(...)`: the axon TPU compiler
+    # drops the dynamic-index scatter write for SOME operands when this
+    # kernel is vmapped at batch >= 4096 inside the expansion program
+    # (silent dedup miscounts, round-2 verdict Weak #2); an elementwise
+    # where over the M lanes compiles to pure selects and is immune.
+    # Other traced-index scatters in the model kernels remain exposed to
+    # the same miscompile class; the systematic defense is the two-chunk
+    # parity gate (checker/parity.py) plus the CPU chunk-sweep tests,
+    # which catch any batch-geometry-dependent divergence before a long
+    # run is trusted.
+    onehot = jnp.arange(cnt.shape[0], dtype=jnp.int32) == slot
+    ins = [jnp.where(onehot, k, w) for w, k in zip(words, key)]
+    cnt_ins = jnp.where(onehot, jnp.int32(1), cnt)
 
     out = [jnp.where(existed, w, wi) for w, wi in zip(words, ins)]
     cnt2 = jnp.where(existed, cnt_inc, cnt_ins)
@@ -79,5 +90,9 @@ def bag_put(hi, lo, cnt, khi, klo):
 
 def bag_discard_at(cnt, slot):
     """``Discard`` (``Raft.tla:164-167``): one fewer delivery; domain keeps
-    the record, so keys don't move and no re-sort is needed."""
-    return cnt.at[slot].add(jnp.int32(-1))
+    the record, so keys don't move and no re-sort is needed.
+
+    One-hot subtract for the same axon scatter-miscompile reason as
+    wide_bag_put."""
+    onehot = jnp.arange(cnt.shape[0], dtype=jnp.int32) == slot
+    return cnt - onehot.astype(cnt.dtype)

@@ -1112,7 +1112,11 @@ class JointRaftOracle:
         symmetry: bool = True,
         max_depth: int | None = None,
         max_states: int | None = None,
+        time_budget_s: float | None = None,
     ) -> dict:
+        import time
+
+        t0 = time.perf_counter()
         init = self.init_state()
         seen = {self.canon(init, symmetry)}
         frontier = [init]
@@ -1123,6 +1127,8 @@ class JointRaftOracle:
         depth = 0
         while frontier and violation is None:
             if max_depth is not None and depth >= max_depth:
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 break
             next_frontier = []
             for st in frontier:
@@ -1145,6 +1151,12 @@ class JointRaftOracle:
                     if violation or (max_states and distinct >= max_states):
                         break
                 if violation or (max_states and distinct >= max_states):
+                    break
+                if (
+                    time_budget_s is not None
+                    and (total & 0x3FF) < 8
+                    and time.perf_counter() - t0 > time_budget_s
+                ):
                     break
             frontier = next_frontier
             if frontier:

@@ -775,7 +775,11 @@ class PullRaftOracle:
         symmetry: bool = True,
         max_depth: int | None = None,
         max_states: int | None = None,
+        time_budget_s: float | None = None,
     ) -> dict:
+        import time
+
+        t0 = time.perf_counter()
         init = self.init_state()
         seen = {self.canon(init, symmetry)}
         frontier = [init]
@@ -786,6 +790,8 @@ class PullRaftOracle:
         depth = 0
         while frontier and violation is None:
             if max_depth is not None and depth >= max_depth:
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 break
             next_frontier = []
             for st in frontier:
@@ -808,6 +814,12 @@ class PullRaftOracle:
                     if violation or (max_states and distinct >= max_states):
                         break
                 if violation or (max_states and distinct >= max_states):
+                    break
+                if (
+                    time_budget_s is not None
+                    and (total & 0x3FF) < 8
+                    and time.perf_counter() - t0 > time_budget_s
+                ):
                     break
             frontier = next_frontier
             if frontier:

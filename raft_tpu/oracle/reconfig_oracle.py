@@ -1109,7 +1109,11 @@ class ReconfigRaftOracle:
         symmetry: bool = True,
         max_depth: int | None = None,
         max_states: int | None = None,
+        time_budget_s: float | None = None,
     ) -> dict:
+        import time
+
+        t0 = time.perf_counter()
         init = self.init_state()
         seen = {self.canon(init, symmetry)}
         frontier = [init]
@@ -1120,6 +1124,8 @@ class ReconfigRaftOracle:
         depth = 0
         while frontier and violation is None:
             if max_depth is not None and depth >= max_depth:
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 break
             next_frontier = []
             for st in frontier:
@@ -1142,6 +1148,12 @@ class ReconfigRaftOracle:
                     if violation or (max_states and distinct >= max_states):
                         break
                 if violation or (max_states and distinct >= max_states):
+                    break
+                if (
+                    time_budget_s is not None
+                    and (total & 0x3FF) < 8
+                    and time.perf_counter() - t0 > time_budget_s
+                ):
                     break
             frontier = next_frontier
             if frontier:

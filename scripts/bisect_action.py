@@ -1,0 +1,86 @@
+"""Stage-5 bisect: which action kernel diverges between batch 383 and 4096
+on axon, when jitted in isolation?"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.symmetry import Canonicalizer
+
+DEPTH = 9
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+p = model.p
+S = p.n_servers
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+
+
+def host_fps(states):
+    return np.array(
+        jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64
+    )
+
+
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt = []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+    frontier = np.asarray(nxt)
+
+F = len(frontier)
+print(f"depth-{DEPTH} frontier: {F}")
+
+iota_s = jnp.arange(S, dtype=jnp.int32)
+pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
+ae_i = jnp.asarray([i for i, _ in pairs], jnp.int32)
+ae_j = jnp.asarray([j for _, j in pairs], jnp.int32)
+M = p.msg_slots
+
+fams = {
+    "restart": lambda s: jax.vmap(lambda i: model._restart(s, i))(iota_s),
+    "request_vote": lambda s: jax.vmap(lambda i: model._request_vote(s, i))(iota_s),
+    "become_leader": lambda s: jax.vmap(lambda i: model._become_leader(s, i))(iota_s),
+    "client_request": lambda s: jax.vmap(
+        lambda i: model._client_request(s, i, jnp.int32(0))
+    )(iota_s),
+    "advance_commit": lambda s: jax.vmap(
+        lambda i: model._advance_commit_index(s, i)
+    )(iota_s),
+    "append_entries": lambda s: jax.vmap(
+        lambda i, j: model._append_entries(s, i, j)
+    )(ae_i, ae_j),
+    "handle_message": lambda s: jax.vmap(
+        lambda m: model._handle_message(s, m)
+    )(jnp.arange(M, dtype=jnp.int32)),
+}
+
+batch = np.zeros((4096, W), np.int32)
+batch[:F] = frontier
+
+for name, fam in fams.items():
+    f = jax.jit(jax.vmap(fam))
+    o_small = jax.device_get(f(frontier))
+    o_big = jax.device_get(f(batch))
+    diffs = []
+    for k, (a, b) in enumerate(zip(o_small, o_big)):
+        a, b = np.asarray(a), np.asarray(b)
+        d = int((a != b[:F]).sum())
+        diffs.append(d)
+    print(f"{name}: per-output mismatches {diffs}")

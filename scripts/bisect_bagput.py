@@ -1,0 +1,132 @@
+"""Stage-6 bisect: instrument the _append_entries -> bag_put pipeline and
+find the first intermediate that differs between batch 383 and batch 4096
+on axon."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.symmetry import Canonicalizer
+from raft_tpu.ops.packing import EMPTY
+from jax import lax
+
+DEPTH = 9
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+p = model.p
+S = p.n_servers
+L = p.max_log
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+
+
+def host_fps(states):
+    return np.array(
+        jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64
+    )
+
+
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt = []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+    frontier = np.asarray(nxt)
+
+F = len(frontier)
+print(f"depth-{DEPTH} frontier: {F}")
+
+pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
+ae_i = jnp.asarray([i for i, _ in pairs], jnp.int32)
+ae_j = jnp.asarray([j for _, j in pairs], jnp.int32)
+
+
+def ae_debug(s, i, j):
+    """_append_entries with every bag_put intermediate returned."""
+    d = model._dec(s)
+    ni_ij = d["nextIndex"][i, j]
+    prev_idx = ni_ij - 1
+    lt_row = d["log_term"][i]
+    lv_row = d["log_value"][i]
+    prev_term = jnp.where(prev_idx > 0, lt_row[jnp.clip(prev_idx - 1, 0, L - 1)], 0)
+    last_entry = jnp.minimum(d["log_len"][i], ni_ij)
+    nent = (last_entry >= ni_ij).astype(jnp.int32)
+    epos = jnp.clip(ni_ij - 1, 0, L - 1)
+    eterm = jnp.where(nent > 0, lt_row[epos], 0)
+    evalue = jnp.where(nent > 0, lv_row[epos], 0)
+    khi, klo = model._pack(
+        mtype=6,  # AEREQ value from raft.py
+        mterm=d["currentTerm"][i],
+        mprevLogIndex=prev_idx,
+        mprevLogTerm=prev_term,
+        nentries=nent,
+        eterm=eterm,
+        evalue=evalue,
+        mcommitIndex=jnp.minimum(d["commitIndex"][i], last_entry),
+        msource=i,
+        mdest=j,
+    )
+    words = [d["msg_hi"], d["msg_lo"]]
+    cnt = d["msg_cnt"]
+    key = (khi, klo)
+    eq = jnp.ones_like(words[0], dtype=bool)
+    for w, k in zip(words, key):
+        eq &= w == k
+    existed = eq.any()
+    cnt_inc = cnt + eq.astype(cnt.dtype)
+    is_empty = words[0] == EMPTY
+    slot = jnp.argmax(is_empty)
+    ins = [w.at[slot].set(k) for w, k in zip(words, key)]
+    cnt_ins = cnt.at[slot].set(jnp.int32(1))
+    out = [jnp.where(existed, w, wi) for w, wi in zip(words, ins)]
+    cnt2 = jnp.where(existed, cnt_inc, cnt_ins)
+    sorted_ = lax.sort((*out, cnt2), num_keys=2)
+    return dict(
+        khi=khi, klo=klo, eq=eq, existed=existed, slot=slot,
+        ins0=ins[0], ins1=ins[1], cnt_ins=cnt_ins,
+        out0=out[0], out1=out[1], cnt2=cnt2,
+        sh=sorted_[0], sl=sorted_[1], sc=sorted_[2],
+    )
+
+
+f = jax.jit(jax.vmap(jax.vmap(ae_debug, in_axes=(None, 0, 0)), in_axes=(0, None, None)))
+o_small = {k: np.asarray(v) for k, v in jax.device_get(f(frontier, ae_i, ae_j)).items()}
+batch = np.zeros((4096, W), np.int32)
+batch[:F] = frontier
+o_big = {k: np.asarray(v) for k, v in jax.device_get(f(batch, ae_i, ae_j)).items()}
+
+for k in ["khi", "klo", "eq", "existed", "slot", "ins0", "ins1", "cnt_ins",
+          "out0", "out1", "cnt2", "sh", "sl", "sc"]:
+    a, b = o_small[k], o_big[k][:F]
+    print(f"{k}: mismatches {int((a != b).sum())}")
+
+# also check the sorted output against numpy lexsort of the device's own
+# pre-sort arrays (batch 4096)
+bad = 0
+o0, o1, c2 = o_big["out0"], o_big["out1"], o_big["cnt2"]
+sh, sl_, sc = o_big["sh"], o_big["sl"], o_big["sc"]
+for b in range(o0.shape[0]):
+    for k in range(o0.shape[1]):
+        order = np.lexsort((o1[b, k], o0[b, k]))
+        if not (
+            np.array_equal(sh[b, k], o0[b, k][order])
+            and np.array_equal(sl_[b, k], o1[b, k][order])
+            and np.array_equal(sc[b, k], c2[b, k][order])
+        ):
+            bad += 1
+print("fused sort rows wrong vs numpy-of-device-presort:", bad)

@@ -1,0 +1,103 @@
+"""Stage-3 bisect: does the 4096-batch vmap expansion or the 65536-lane
+compaction gather corrupt successor states on the TPU?
+
+Compares, for the depth-9 Raft.cfg frontier (383 states):
+  A. vmap(model._expand1) at batch 383 vs batch 4096 (rows 0..382)
+  B. the fused compaction gather flatp[sel] vs a numpy gather of the same
+     succs with the same sel
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.symmetry import Canonicalizer
+
+DEPTH = 9
+C = 4096
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+
+
+def host_fps(states):
+    return np.array(
+        jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64
+    )
+
+
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt = []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+    frontier = np.asarray(nxt)
+
+F = len(frontier)
+print(f"depth-{DEPTH} frontier: {F}")
+
+succs_s, valid_s, _r, _o = jax.device_get(expand1(frontier))  # batch 383
+
+batch = np.zeros((C, W), np.int32)
+batch[:F] = frontier
+succs_b, valid_b, _r2, _o2 = jax.device_get(expand1(batch))  # batch 4096
+
+dv = (valid_s != valid_b[:F]).sum()
+print("A. valid mismatches (383 vs 4096 batch):", int(dv))
+ds = (succs_s != succs_b[:F]).sum(), int(
+    ((succs_s != succs_b[:F]) & valid_s[:, :, None]).sum()
+)
+print("A. succ word mismatches (all lanes, valid lanes):", ds)
+
+# B. the compaction gather inside a jit at 65536 lanes
+VC = C * 16
+live = np.arange(C) < F
+
+
+@jax.jit
+def compact(batch):
+    succs, valid, _rank, _ovf = jax.vmap(model._expand1)(batch)
+    valid = valid & jnp.asarray(live)[:, None]
+    vflat = valid.reshape(-1)
+    vpos = jnp.cumsum(vflat) - 1
+    sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+    sel = (
+        jnp.full((VC + 1,), C * A, jnp.int32)
+        .at[sdst]
+        .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+    )
+    flatp = jnp.concatenate(
+        [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
+    )
+    return succs, sel, flatp[sel]
+
+
+succs_f, sel, flatc = (np.asarray(jax.device_get(x)) for x in compact(batch))
+print("B. fused succs == plain 4096-batch succs:",
+      bool((succs_f == succs_b).all()))
+flat_np = succs_f.reshape(C * A, W)
+flatp_np = np.concatenate([flat_np, np.zeros((1, W), np.int32)], axis=0)
+expect = flatp_np[sel]
+bad = np.nonzero((flatc != expect).any(axis=1))[0]
+print("B. gather mismatching lanes:", len(bad))
+if len(bad):
+    b = bad[0]
+    print("lane", b, "sel", sel[b])
+    print("device row:", flatc[b])
+    print("expected  :", expect[b])

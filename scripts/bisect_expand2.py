@@ -1,0 +1,70 @@
+"""Stage-4 bisect: characterize the batch-size-dependent vmap expansion
+divergence on axon. Checks determinism, affected batch sizes, and the
+specific (row, action, word) lanes that differ.
+"""
+
+import numpy as np
+import jax
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.symmetry import Canonicalizer
+
+DEPTH = 9
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+
+
+def host_fps(states):
+    return np.array(
+        jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64
+    )
+
+
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt = []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+    frontier = np.asarray(nxt)
+
+F = len(frontier)
+succs_s, valid_s, rank_s, _ = jax.device_get(expand1(frontier))
+
+for B in (512, 1024, 2048, 4096, 8192):
+    batch = np.zeros((B, W), np.int32)
+    batch[:F] = frontier
+    s1, v1, _, _ = jax.device_get(expand1(batch))
+    s2, v2, _, _ = jax.device_get(expand1(batch))
+    det = (np.asarray(s1) == np.asarray(s2)).all() and (
+        np.asarray(v1) == np.asarray(v2)
+    ).all()
+    mm = int(((np.asarray(s1)[:F] != succs_s) & valid_s[:, :, None]).sum())
+    vm = int((np.asarray(v1)[:F] != valid_s).sum())
+    print(f"batch {B}: deterministic={bool(det)} succ-mismatch-words={mm} valid-mismatch={vm}")
+    if mm and B == 4096:
+        d = (np.asarray(s1)[:F] != succs_s) & valid_s[:, :, None]
+        rows, acts, words = np.nonzero(d)
+        print("  affected rows:", sorted(set(rows.tolist()))[:10])
+        print("  affected actions:", sorted(set(acts.tolist())))
+        print("  affected words:", sorted(set(words.tolist())))
+        r, a = rows[0], acts[0]
+        print("  example row", r, "action", a, model.action_label(int(rank_s[r, a]), int(a)) if hasattr(model, "action_label") else "")
+        print("  batch-383 succ:", succs_s[r, a])
+        print("  batch-4096 succ:", np.asarray(s1)[r, a])
+        print("  input state:   ", frontier[r])

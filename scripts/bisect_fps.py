@@ -1,0 +1,83 @@
+"""Stage-2 bisect: do canonical fingerprints of the SAME states differ by
+batch size on the TPU?  Compares canon.fingerprints over the depth-9 wave's
+compacted successors evaluated at 65536-lane batch vs 2048-lane chunks vs
+numpy decode-level recomputation of the hash on host.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.hashing import U64_MAX
+from raft_tpu.ops.symmetry import Canonicalizer
+
+DEPTH = 9
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+
+
+def host_fps(states):
+    return np.array(
+        jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64
+    )
+
+
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt = []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+    frontier = np.asarray(nxt)
+
+F = len(frontier)
+print(f"depth-{DEPTH} frontier: {F}")
+
+# expand the frontier once more (383-batch, same as host loop)
+succs, valid, _r, _o = jax.device_get(expand1(frontier))
+flat = succs.reshape(-1, W)
+v = valid.reshape(-1)
+idxs = np.nonzero(v)[0]
+cand = flat[idxs]  # [1762, W] the true successor states
+n = len(cand)
+print("candidates:", n)
+
+# pad to the two batch geometries and fingerprint
+def fps_at(width):
+    buf = np.zeros((width, W), np.int32)
+    buf[:n] = cand
+    out = np.array(jax.device_get(canon.fingerprints(buf)), dtype=np.uint64)
+    return out[:n]
+
+f_small = fps_at(2048)
+f_65k = fps_at(65536)
+f_native = host_fps(cand)  # whatever batch n=1762 compiles to
+
+print("65k vs 2048 mismatches:", int((f_65k != f_small).sum()))
+print("native vs 2048 mismatches:", int((f_native != f_small).sum()))
+
+bad = np.nonzero(f_65k != f_small)[0]
+if len(bad):
+    b = bad[0]
+    print("first bad lane:", b)
+    print("state:", cand[b])
+    print("fp small: %016x" % f_small[b], " fp 65k: %016x" % f_65k[b])
+    # recompute the same lane alone
+    one = np.array(jax.device_get(canon.fingerprints(cand[b : b + 1])), dtype=np.uint64)
+    print("fp alone: %016x" % one[0])

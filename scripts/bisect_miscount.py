@@ -1,0 +1,143 @@
+"""Bisect the chunk=4096 TPU dedup miscount inside DeviceBFS._chunk_step.
+
+Builds the (known-correct) depth-9 frontier of Raft.cfg with a host-numpy
+BFS, then runs the depth-9 -> depth-10 expansion through the same staged
+computation as _chunk_step on device, fetching each intermediate and
+comparing with a numpy recomputation from the device's own upstream
+outputs. The first diverging stage is the culprit.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.cfg import parse_cfg
+from raft_tpu.models.registry import build_from_cfg
+from raft_tpu.ops.hashing import U64_MAX
+from raft_tpu.ops.symmetry import Canonicalizer
+
+DEPTH = 9
+CHUNK = 4096
+
+cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+setup = build_from_cfg(cfg, msg_slots=32)
+model = setup.model
+canon = Canonicalizer.for_model(model, symmetry=True)
+W, A = model.layout.W, model.A
+
+# ---- host BFS to depth 9 (numpy dedup; ground truth) ----
+def host_fps(states):
+    return np.asarray(jax.device_get(canon.fingerprints(np.asarray(states))), dtype=np.uint64)
+
+expand1 = jax.jit(jax.vmap(model._expand1))
+init = model.init_states()
+frontier = np.asarray(init)
+seen = set(host_fps(frontier).tolist())
+for d in range(DEPTH):
+    succs, valid, _r, _o = jax.device_get(expand1(frontier))
+    flat = succs.reshape(-1, W)
+    v = valid.reshape(-1)
+    fps = host_fps(flat)
+    nxt, nfp = [], []
+    for i in np.nonzero(v)[0]:
+        f = int(fps[i])
+        if f not in seen:
+            seen.add(f)
+            nxt.append(flat[i])
+            nfp.append(f)
+    frontier = np.asarray(nxt)
+    print(f"host depth {d+1}: new {len(frontier)}")
+
+F = len(frontier)
+print(f"depth-{DEPTH} frontier: {F} states, seen={len(seen)}")
+
+# ---- device stage-by-stage at chunk=4096 geometry ----
+C = CHUNK
+VC = C * 16
+SCAP = 1 << 21
+batch = np.zeros((C, W), np.int32)
+batch[:F] = frontier
+live = np.arange(C) < F
+
+seen_arr = np.full(SCAP, np.uint64(U64_MAX), dtype=np.uint64)
+sl = np.sort(np.fromiter(seen, dtype=np.uint64))
+seen_arr[: len(sl)] = sl
+seen_arr.sort()
+
+@jax.jit
+def stage_all(batch, seen):
+    succs, valid, _rank, _ovf = jax.vmap(model._expand1)(batch)
+    valid = valid & jnp.asarray(live)[:, None]
+    vflat = valid.reshape(-1)
+    vpos = jnp.cumsum(vflat) - 1
+    sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+    sel = (
+        jnp.full((VC + 1,), C * A, jnp.int32)
+        .at[sdst]
+        .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+    )
+    selv = sel < C * A
+    flatp = jnp.concatenate(
+        [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
+    )
+    flatc = flatp[sel]
+    fps = canon._fingerprints(flatc)
+    fps = jnp.where(selv, fps, U64_MAX)
+    pos = jnp.clip(jnp.searchsorted(seen, fps), 0, seen.shape[0] - 1)
+    in_seen = seen[pos] == fps
+    fresh = ~in_seen & (fps != U64_MAX)
+    order = jnp.argsort(fps, stable=True)
+    rf = fps[order]
+    first_s = jnp.ones((VC,), bool).at[1:].set(rf[1:] != rf[:-1])
+    first = jnp.zeros((VC,), bool).at[order].set(first_s)
+    new = fresh & first
+    return valid, sel, flatc, fps, in_seen, order, rf, first, new
+
+valid, sel, flatc, fps, in_seen, order, rf, first, new = (
+    np.asarray(jax.device_get(x)) for x in stage_all(batch, seen_arr)
+)
+
+print("n_new (device):", int(new.sum()))
+
+# numpy recomputation from the device's own flatc/fps
+vflat = valid.reshape(-1)
+np_sel_count = int(vflat.sum())
+print("valid count:", np_sel_count)
+
+# stage A: sel correctness (compaction)
+sel_expected = np.full(VC, C * A, np.int64)
+idxs = np.nonzero(vflat)[0]
+sel_expected[: len(idxs)] = idxs
+badA = (sel.astype(np.int64) != sel_expected).sum()
+print("stage A (compaction sel) mismatches:", badA)
+
+# stage B: fingerprints — recompute on device in a separate small program
+fps2 = np.array(
+    jax.device_get(canon.fingerprints(np.asarray(flatc))), dtype=np.uint64
+)
+selv = sel < C * A
+fps2[~selv] = np.uint64(U64_MAX)
+badB = (fps != fps2).sum()
+print("stage B (fingerprints in fused vs standalone) mismatches:", badB)
+
+# stage C: in_seen probe
+np_in_seen = np.isin(fps, sl)
+badC = (in_seen != np_in_seen).sum()
+print("stage C (seen probe) mismatches:", badC)
+
+# stage D: argsort/first-occurrence
+np_order = np.argsort(fps, kind="stable")
+np_rf = fps[np_order]
+sorted_ok = bool(np.all(rf[1:] >= rf[:-1]))
+print("stage D rf sorted:", sorted_ok, "| rf == np_rf:", bool(np.all(rf == np_rf)))
+np_first_s = np.ones(VC, bool)
+np_first_s[1:] = np_rf[1:] != np_rf[:-1]
+np_first = np.zeros(VC, bool)
+np_first[np_order] = np_first_s
+badD = (first != np_first).sum()
+print("stage D (first-occurrence) mismatches:", badD)
+
+# stage E: final new mask
+np_new = ~np_in_seen & (fps != np.uint64(U64_MAX)) & np_first
+badE = (new != np_new).sum()
+print("stage E (new mask) mismatches:", badE, "| numpy n_new:", int(np_new.sum()))
