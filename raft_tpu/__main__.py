@@ -35,6 +35,18 @@ def main(argv=None):
                     help="device trace-journal capacity (tpu checker)")
     ap.add_argument("--time-budget", type=float, default=None,
                     help="stop (non-exhausted) after this many seconds")
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="periodically save resumable run state (tpu checker)")
+    ap.add_argument("--checkpoint-every", type=float, default=300.0,
+                    metavar="S", help="seconds between checkpoints")
+    ap.add_argument("--resume", default=None, metavar="PATH",
+                    help="resume a run from a --checkpoint file (tpu checker)")
+    ap.add_argument("--max-frontier-cap", type=int, default=None,
+                    help="frontier growth bound (tpu checker)")
+    ap.add_argument("--max-seen-cap", type=int, default=None,
+                    help="seen-set growth bound (tpu checker)")
+    ap.add_argument("--max-journal-cap", type=int, default=None,
+                    help="journal growth bound (tpu checker)")
     ap.add_argument("--max-depth", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
     ap.add_argument(
@@ -189,6 +201,9 @@ def main(argv=None):
                 "frontier_cap": args.frontier_cap,
                 "seen_cap": args.seen_cap,
                 "journal_cap": args.journal_cap,
+                "max_frontier_cap": args.max_frontier_cap,
+                "max_seen_cap": args.max_seen_cap,
+                "max_journal_cap": args.max_journal_cap,
             }.items()
             if v is not None
         }
@@ -208,10 +223,18 @@ def main(argv=None):
             symmetry=symmetry,
             chunk=args.chunk,
         )
+    run_kw = {}
+    if args.checker == "tpu":
+        run_kw = dict(
+            checkpoint_path=args.checkpoint,
+            checkpoint_every_s=args.checkpoint_every,
+            resume=args.resume,
+        )
     res = checker.run(
         max_depth=args.max_depth,
         verbose=args.verbose,
         time_budget_s=args.time_budget,
+        **run_kw,
     )
     print(
         f"distinct={res.distinct} total={res.total} depth={res.depth} "

@@ -52,15 +52,27 @@ def _probe(sorted_arr, vals):
 class DeviceBFS:
     """Single-device BFS with device-resident frontier/seen-set/journal.
 
-    Capacities are static (XLA shapes); every one is guarded by an
-    overflow flag that aborts the run rather than dropping states:
+    Capacities are static (XLA shapes) but GROW between waves: when a
+    wave ends within 3x of a buffer's capacity, the buffer is enlarged
+    4x (up to the max_* bound) and the wave program retraces at the new
+    shapes. Growth happens between waves only, so the hot loop stays a
+    single fused program; the overflow flags remain as a hard backstop
+    that aborts rather than dropping states (a wave that more than
+    triples is the only way to hit them).
       frontier_cap   per-wave distinct states (frontier buffer rows)
       seen_cap       total distinct states (sorted fingerprint array)
       journal_cap    total distinct states beyond Init (trace journal)
       valid_per_state  compaction budget: avg valid successors per state
                        (Raft-family specs average ~5 of A~53; 16 is
                        generous, overflow-checked)
+
+    Checkpoint/resume (SURVEY.md §5.4; TLC has it built in): pass
+    checkpoint_path (+ checkpoint_every_s) to run(), and resume= to pick
+    a run back up from the saved seen-set/frontier/journal.
     """
+
+    GROWTH = 4  # enlarge factor per growth step
+    HEADROOM = 3  # grow when the next wave could need more than cap/HEADROOM
 
     def __init__(
         self,
@@ -73,6 +85,9 @@ class DeviceBFS:
         journal_cap: int = 1 << 22,
         valid_per_state: int = 16,
         check_deadlock: bool = False,
+        max_frontier_cap: int = 1 << 22,
+        max_seen_cap: int = 1 << 25,
+        max_journal_cap: int = 1 << 25,
     ):
         self.model = model
         self.invariants = tuple(invariants)
@@ -83,6 +98,9 @@ class DeviceBFS:
         self.FCAP = frontier_cap
         self.SCAP = seen_cap
         self.JCAP = journal_cap
+        self.MAX_FCAP = max(max_frontier_cap, frontier_cap)
+        self.MAX_SCAP = max(max_seen_cap, seen_cap)
+        self.MAX_JCAP = max(max_journal_cap, journal_cap)
         self.VC = min(chunk * self.A, chunk * valid_per_state)
         assert chunk <= frontier_cap
         # the per-chunk dynamic_slice would clamp an out-of-bounds start and
@@ -196,6 +214,56 @@ class DeviceBFS:
         stats = stats.at[0].set(0)
         return merged, fresh, stats
 
+    # ---------------- capacity growth ----------------
+
+    @staticmethod
+    def _next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:
+        """Smallest growth**k * cap >= needed (clamped to max_cap, rounded
+        up to a multiple of unit)."""
+        new = cap
+        while new < needed and new < max_cap:
+            new = min(new * growth, max_cap)
+        new = ((new + unit - 1) // unit) * unit
+        return new
+
+    def _maybe_grow(
+        self, ncount, scount, frontier, next_buf, wave_fps, seen, jparent, jcand
+    ):
+        """Between waves: enlarge any buffer the next wave could outgrow.
+        Frontier growth is speculative (next wave's new count is unknown;
+        observed BFS wave growth is <=~2.2x, HEADROOM=3 covers it); seen/
+        journal growth is exact (they grow by ncount per wave)."""
+        W = self.W
+        jcount = scount - len(self._init_distinct)
+        if ncount * self.HEADROOM > self.FCAP and self.FCAP < self.MAX_FCAP:
+            new = self._next_cap(
+                ncount * self.HEADROOM, self.FCAP, self.MAX_FCAP, self.GROWTH, self.chunk
+            )
+            pad = new - self.FCAP
+            frontier = jnp.concatenate(
+                [frontier, jnp.zeros((pad, W), jnp.int32)], axis=0
+            )
+            next_buf = jnp.zeros((new + 1, W), jnp.int32)
+            wave_fps = jnp.full((new + 1,), U64_MAX, jnp.uint64)
+            self.FCAP = new
+        if scount + ncount * self.HEADROOM > self.SCAP and self.SCAP < self.MAX_SCAP:
+            new = self._next_cap(
+                scount + ncount * self.HEADROOM, self.SCAP, self.MAX_SCAP, self.GROWTH, 1
+            )
+            seen = jnp.concatenate(
+                [seen, jnp.full((new - self.SCAP,), U64_MAX, jnp.uint64)]
+            )
+            self.SCAP = new
+        if jcount + ncount * self.HEADROOM > self.JCAP and self.JCAP < self.MAX_JCAP:
+            new = self._next_cap(
+                jcount + ncount * self.HEADROOM, self.JCAP, self.MAX_JCAP, self.GROWTH, 1
+            )
+            pad = new - self.JCAP
+            jparent = jnp.concatenate([jparent, jnp.zeros((pad,), jnp.int32)])
+            jcand = jnp.concatenate([jcand, jnp.zeros((pad,), jnp.int32)])
+            self.JCAP = new
+        return frontier, next_buf, wave_fps, seen, jparent, jcand
+
     # ---------------- host driver ----------------
 
     def run(
@@ -204,9 +272,12 @@ class DeviceBFS:
         verbose: bool = False,
         time_budget_s: float | None = None,
         collect_metrics: bool = False,
+        checkpoint_path: str | None = None,
+        checkpoint_every_s: float = 300.0,
+        resume: str | None = None,
     ) -> CheckResult:
         model = self.model
-        C, W, FCAP = self.chunk, self.W, self.FCAP
+        C, W = self.chunk, self.W
         t0 = time.perf_counter()
         exhausted = True
 
@@ -222,36 +293,77 @@ class DeviceBFS:
         keep[order[dup]] = False
         init_d = np.asarray(init[keep])
         n0 = len(init_d)
-        assert n0 <= FCAP, "initial states exceed frontier_cap"
+        assert n0 <= self.FCAP, "initial states exceed frontier_cap"
         self._init_distinct = init_d
 
-        violation = self._check_init(init_d)
-
-        seen_h = np.full(self.SCAP, np.uint64(U64_MAX), dtype=np.uint64)
-        seen_h[:n0] = np.sort(init_fps[keep])
-        seen_h.sort()
-        frontier_h = np.zeros((FCAP + 1, W), dtype=np.int32)
-        frontier_h[:n0] = init_d
+        if resume is not None:
+            ck = np.load(resume, allow_pickle=False)
+            ident = self._ckpt_ident()
+            if str(ck["spec"]) != ident:
+                raise ValueError(
+                    f"checkpoint is for spec {ck['spec']}, model is {ident}"
+                )
+            fcount = int(ck["fcount"])
+            scount = int(ck["scount"])
+            jcount = int(ck["jcount"])
+            # round caps up so the saved contents fit with headroom
+            self.FCAP = self._next_cap(
+                max(self.FCAP, fcount * self.HEADROOM),
+                self.FCAP, self.MAX_FCAP, self.GROWTH, self.chunk)
+            self.SCAP = self._next_cap(
+                max(self.SCAP, scount + fcount * self.HEADROOM),
+                self.SCAP, self.MAX_SCAP, self.GROWTH, 1)
+            self.JCAP = self._next_cap(
+                max(self.JCAP, jcount + fcount * self.HEADROOM),
+                self.JCAP, self.MAX_JCAP, self.GROWTH, 1)
+            frontier_h = np.zeros((self.FCAP + 1, W), dtype=np.int32)
+            frontier_h[:fcount] = ck["frontier"]
+            seen_h = np.full(self.SCAP, np.uint64(U64_MAX), dtype=np.uint64)
+            seen_h[:scount] = ck["seen"]
+            jparent_h = np.zeros((self.JCAP + 1,), np.int32)
+            jparent_h[:jcount] = ck["jparent"]
+            jcand_h = np.zeros((self.JCAP + 1,), np.int32)
+            jcand_h[:jcount] = ck["jcand"]
+            violation = None
+            distinct = int(ck["distinct"])
+            total = int(ck["total"])
+            terminal = int(ck["terminal"])
+            depth = int(ck["depth"])
+            base_gid = int(ck["base_gid"])
+            gen_prev = int(ck["gen_prev"])
+            depth_counts = list(ck["depth_counts"])
+            stats0 = np.array([0, jcount, gen_prev, terminal, 0], dtype=np.int64)
+        else:
+            violation = self._check_init(init_d)
+            seen_h = np.full(self.SCAP, np.uint64(U64_MAX), dtype=np.uint64)
+            seen_h[:n0] = np.sort(init_fps[keep])
+            seen_h.sort()
+            frontier_h = np.zeros((self.FCAP + 1, W), dtype=np.int32)
+            frontier_h[:n0] = init_d
+            jparent_h = np.zeros((self.JCAP + 1,), np.int32)
+            jcand_h = np.zeros((self.JCAP + 1,), np.int32)
+            fcount = n0
+            scount = n0
+            distinct = n0
+            total = len(init)  # pre-dedup, matching BFSChecker's seeding
+            terminal = 0
+            depth = 0
+            base_gid = 0
+            depth_counts = [n0]
+            gen_prev = 0
+            stats0 = np.zeros((5,), dtype=np.int64)
 
         frontier = jnp.asarray(frontier_h)
-        next_buf = jnp.zeros((FCAP + 1, W), jnp.int32)
+        next_buf = jnp.zeros((self.FCAP + 1, W), jnp.int32)
         seen = jnp.asarray(seen_h)
-        wave_fps = jnp.full((FCAP + 1,), U64_MAX, jnp.uint64)
-        jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
-        jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
+        wave_fps = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
+        jparent = jnp.asarray(jparent_h)
+        jcand = jnp.asarray(jcand_h)
         viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
-        stats = jnp.zeros((5,), jnp.int64)
+        stats = jnp.asarray(stats0)
 
-        fcount = n0
-        scount = n0
-        distinct = n0
-        total = len(init)  # pre-dedup, matching BFSChecker's seeding
-        terminal = 0
-        depth = 0
-        base_gid = 0
-        depth_counts = [n0]
-        gen_prev = 0
         metrics: list[dict] | None = [] if collect_metrics else None
+        last_ckpt = time.perf_counter()
 
         while fcount and violation is None:
             if max_depth is not None and depth >= max_depth:
@@ -269,11 +381,22 @@ class DeviceBFS:
             stats_h = np.asarray(jax.device_get(stats))
             ncount = int(stats_h[0])
             ovf_bits = int(stats_h[4])
-            if ovf_bits:
-                raise OverflowError(
-                    f"device BFS capacity overflow (bits={ovf_bits:04b}: "
-                    "1=msg-slots 2=valid_per_state 4=frontier_cap 8=journal_cap)"
-                )
+            if ovf_bits or scount + ncount > self.SCAP:
+                # wave-start state is still intact (frontier/seen are only
+                # mutated by _finalize below); save it so a re-run with
+                # bigger caps can resume instead of starting over
+                if checkpoint_path is not None:
+                    self._save_checkpoint(
+                        checkpoint_path, frontier, seen, jparent, jcand,
+                        fcount, scount, distinct, total, terminal, depth,
+                        base_gid, gen_prev, depth_counts,
+                    )
+                if ovf_bits:
+                    raise OverflowError(
+                        f"device BFS capacity overflow (bits={ovf_bits:04b}: "
+                        "1=msg-slots 2=valid_per_state 4=frontier_cap 8=journal_cap)"
+                    )
+                raise OverflowError("seen-set capacity overflow; raise seen_cap")
             n_gen = int(stats_h[2])
             wave_gen = n_gen - gen_prev
             total += wave_gen
@@ -282,8 +405,6 @@ class DeviceBFS:
             if ncount == 0:
                 break
             scount += ncount
-            if scount > self.SCAP:
-                raise OverflowError("seen-set capacity overflow; raise seen_cap")
             depth += 1
             distinct += ncount
             depth_counts.append(ncount)
@@ -300,6 +421,20 @@ class DeviceBFS:
             frontier, next_buf = next_buf, frontier
             prev_fcount = fcount
             fcount = ncount
+            frontier, next_buf, wave_fps, seen, jparent, jcand = self._maybe_grow(
+                ncount, scount, frontier, next_buf, wave_fps, seen, jparent, jcand
+            )
+            if (
+                checkpoint_path is not None
+                and violation is None  # a saved file must not mask a violation
+                and time.perf_counter() - last_ckpt > checkpoint_every_s
+            ):
+                self._save_checkpoint(
+                    checkpoint_path, frontier, seen, jparent, jcand, fcount,
+                    scount, distinct, total, terminal, depth, base_gid,
+                    gen_prev, depth_counts,
+                )
+                last_ckpt = time.perf_counter()
             if metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
@@ -339,6 +474,48 @@ class DeviceBFS:
             metrics=metrics,
         )
         return res
+
+    def _ckpt_ident(self) -> str:
+        """Everything the saved fingerprints/arrays depend on: symmetry
+        mode changes the canonical fingerprints, so it must match too."""
+        return (
+            f"{self.model.name}/{self.model.p}/W={self.W}"
+            f"/sym={self.canon.symmetry}"
+        )
+
+    def _save_checkpoint(
+        self, path, frontier, seen, jparent, jcand, fcount, scount, distinct,
+        total, terminal, depth, base_gid, gen_prev, depth_counts,
+    ):
+        """Spill the resumable run state to an .npz (atomic rename).
+        Saved at wave boundaries only, so the arrays are consistent."""
+        import os
+
+        n0 = len(self._init_distinct)
+        jcount = scount - n0
+        tmp = f"{path}.tmp.npz"  # .npz suffix stops savez renaming it
+        # uncompressed: multi-GB checkpoints on a 1-core host must not
+        # stall the device loop for minutes of zlib
+        np.savez(
+            tmp,
+            version=1,
+            spec=self._ckpt_ident(),
+            fcount=fcount,
+            scount=scount,
+            jcount=jcount,
+            frontier=np.asarray(jax.device_get(frontier[:fcount])),
+            seen=np.asarray(jax.device_get(seen[:scount])),
+            jparent=np.asarray(jax.device_get(jparent[:jcount])),
+            jcand=np.asarray(jax.device_get(jcand[:jcount])),
+            distinct=distinct,
+            total=total,
+            terminal=terminal,
+            depth=depth,
+            base_gid=base_gid,
+            gen_prev=gen_prev,
+            depth_counts=np.asarray(depth_counts, dtype=np.int64),
+        )
+        os.replace(tmp, path)
 
     def _check_init(self, init_d: np.ndarray) -> Violation | None:
         for name in self.invariants:

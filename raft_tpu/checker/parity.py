@@ -37,32 +37,48 @@ class ParityGateResult:
 
 
 def parity_gate(
-    model,
+    model=None,
     invariants: tuple[str, ...] = (),
     symmetry: bool = True,
     depth: int = 12,
     chunks: tuple[int, int] = (2048, 4096),
     frontier_cap: int = 1 << 16,
     seen_cap: int = 1 << 20,
+    checkers: tuple[DeviceBFS, DeviceBFS] | None = None,
 ) -> ParityGateResult:
     """Run the workload to `depth` at two chunk geometries; identical
-    depth_counts/total/terminal => gate passes."""
+    depth_counts/total/terminal => gate passes.
+
+    Pass prebuilt `checkers` (e.g. to reuse a long run's compiled
+    instance as one arm) or let the gate build both from `model`. The
+    two arms must have different chunk geometries — identical geometries
+    would make the gate vacuous."""
+    if checkers is None:
+        checkers = tuple(
+            DeviceBFS(
+                model,
+                invariants=invariants,
+                symmetry=symmetry,
+                chunk=chunk,
+                frontier_cap=frontier_cap,
+                seen_cap=seen_cap,
+                journal_cap=seen_cap,
+            )
+            for chunk in chunks
+        )
+    if checkers[0].chunk == checkers[1].chunk:
+        raise ValueError(
+            f"parity gate arms share chunk={checkers[0].chunk}; the gate "
+            "needs two different geometries to mean anything"
+        )
     sigs = []
-    for chunk in chunks:
-        res = DeviceBFS(
-            model,
-            invariants=invariants,
-            symmetry=symmetry,
-            chunk=chunk,
-            frontier_cap=frontier_cap,
-            seen_cap=seen_cap,
-            journal_cap=seen_cap,
-        ).run(max_depth=depth)
+    for checker in checkers:
+        res = checker.run(max_depth=depth)
         sigs.append((res.depth_counts, res.total, res.terminal))
     ok = sigs[0] == sigs[1]
     return ParityGateResult(
         ok=ok,
         depth=depth,
-        chunks=tuple(chunks),
+        chunks=(checkers[0].chunk, checkers[1].chunk),
         counts=(sigs[0][0], sigs[1][0]),
     )

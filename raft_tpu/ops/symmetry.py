@@ -74,6 +74,7 @@ class Canonicalizer:
         assert VL is not None
         self.layout = layout
         self.packer = packer
+        self.symmetry = symmetry
         # Unified remap spec: (packed field, kind) with kind one of
         #   server          plain server index (msource/mdest)
         #   server_nil      0 = Nil, i+1 = server i (KRaft mleader)

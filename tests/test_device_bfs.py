@@ -75,7 +75,6 @@ def test_device_bfs_trace_on_injected_invariant():
     final = res.trace[-1][1]
     assert any(ci > 0 for ci in final["commitIndex"])
     # shortest-counterexample depth must agree with the host checker's
-    host = BFSChecker(model, invariants=(), symmetry=True, chunk=256)
     model.invariants["NoCommit"] = no_commit
     try:
         hres = BFSChecker(model, invariants=("NoCommit",), symmetry=True, chunk=256).run()
@@ -96,3 +95,67 @@ def test_device_bfs_max_depth_and_time_budget():
 def test_device_bfs_rejects_indivisible_chunk():
     with pytest.raises(AssertionError):
         _device(SMALL, INVS, chunk=768, frontier_cap=1 << 13)
+
+
+def test_device_bfs_capacity_growth():
+    """Tiny initial caps; the run must grow all three buffers between
+    waves and still produce exact counts (no states dropped)."""
+    ref = _device(SMALL, INVS).run()
+    grown = _device(
+        SMALL,
+        INVS,
+        chunk=128,
+        frontier_cap=256,
+        seen_cap=512,
+        journal_cap=512,
+        max_frontier_cap=1 << 14,
+        max_seen_cap=1 << 17,
+        max_journal_cap=1 << 17,
+    )
+    res = grown.run()
+    assert grown.FCAP > 256 and grown.SCAP > 512 and grown.JCAP > 512
+    assert res.distinct == ref.distinct
+    assert res.depth_counts == ref.depth_counts
+    assert res.total == ref.total
+    assert res.terminal == ref.terminal
+
+
+def test_device_bfs_checkpoint_resume(tmp_path):
+    """Split a run at a depth cap via checkpoint, resume in a fresh
+    checker, and require the stitched result to equal a straight run —
+    including a violation trace that crosses the checkpoint boundary."""
+    import jax.numpy as jnp
+
+    model = cached_model(SMALL)
+    lay = model.layout
+
+    def no_commit(states):
+        ci = lay.get(states, "commitIndex")
+        return jnp.all(ci == 0, axis=1)
+
+    ck = str(tmp_path / "run.ckpt.npz")
+    model.invariants["NoCommit"] = no_commit
+    try:
+        first = _device(SMALL, ("NoCommit",))
+        r1 = first.run(max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0)
+        assert r1.violation is None and not r1.exhausted
+        second = _device(SMALL, ("NoCommit",))
+        r2 = second.run(resume=ck)
+        straight = _device(SMALL, ("NoCommit",)).run()
+    finally:
+        del model.invariants["NoCommit"]
+    assert r2.violation is not None and straight.violation is not None
+    assert r2.violation.depth == straight.violation.depth
+    assert r2.distinct == straight.distinct
+    assert r2.depth_counts == straight.depth_counts
+    assert [a for a, _ in r2.trace] == [a for a, _ in straight.trace]
+
+
+def test_device_bfs_checkpoint_spec_mismatch(tmp_path):
+    other = RaftParams(
+        n_servers=2, n_values=1, max_elections=1, max_restarts=0, msg_slots=16
+    )
+    ck = str(tmp_path / "run.ckpt.npz")
+    _device(SMALL, INVS).run(max_depth=3, checkpoint_path=ck, checkpoint_every_s=0.0)
+    with pytest.raises(ValueError, match="checkpoint is for spec"):
+        _device(other, INVS).run(resume=ck)
