@@ -101,7 +101,11 @@ def main():
         "metric": "distinct_states_per_sec_raft3_cfg",
         "value": round(deep.states_per_sec, 1),
         "unit": "distinct states/s",
-        "vs_baseline": round(t_oracle / t_tpu, 2) if t_tpu > 0 else None,
+        # the ratio is only meaningful on the identical workload: null it
+        # out if the oracle diverged or was cut short by its own budget
+        "vs_baseline": (
+            round(t_oracle / t_tpu, 2) if t_tpu > 0 and same_workload else None
+        ),
         "detail": {
             "deep": {
                 "distinct": deep.distinct,

@@ -38,15 +38,7 @@ from jax import lax
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
-
-I32_MAX = np.int32(2**31 - 1)
-
-
-def _probe(sorted_arr, vals):
-    """Membership of vals in a sorted u64 array padded with U64_MAX."""
-    pos = jnp.searchsorted(sorted_arr, vals)
-    pos = jnp.clip(pos, 0, sorted_arr.shape[0] - 1)
-    return sorted_arr[pos] == vals
+from .util import GROWTH, HEADROOM, I32_MAX, next_cap, probe_sorted as _probe
 
 
 class DeviceBFS:
@@ -71,8 +63,8 @@ class DeviceBFS:
     a run back up from the saved seen-set/frontier/journal.
     """
 
-    GROWTH = 4  # enlarge factor per growth step
-    HEADROOM = 3  # grow when the next wave could need more than cap/HEADROOM
+    GROWTH = GROWTH
+    HEADROOM = HEADROOM
 
     def __init__(
         self,
@@ -216,15 +208,7 @@ class DeviceBFS:
 
     # ---------------- capacity growth ----------------
 
-    @staticmethod
-    def _next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:
-        """Smallest growth**k * cap >= needed (clamped to max_cap, rounded
-        up to a multiple of unit)."""
-        new = cap
-        while new < needed and new < max_cap:
-            new = min(new * growth, max_cap)
-        new = ((new + unit - 1) // unit) * unit
-        return new
+    _next_cap = staticmethod(next_cap)
 
     def _maybe_grow(
         self, ncount, scount, frontier, next_buf, wave_fps, seen, jparent, jcand
@@ -454,6 +438,16 @@ class DeviceBFS:
                         f"total {total}, {distinct/el:.0f} distinct/s"
                     )
 
+        if checkpoint_path is not None and violation is None and not exhausted:
+            # budget/depth-capped exit: the loop broke at a wave boundary,
+            # so save a final resumable snapshot (the periodic timer alone
+            # can leave no checkpoint at all on short-budget runs)
+            self._save_checkpoint(
+                checkpoint_path, frontier, seen, jparent, jcand, fcount,
+                scount, distinct, total, terminal, depth, base_gid,
+                gen_prev, depth_counts,
+            )
+
         self._jparent = jparent
         self._jcand = jcand
         self._jcount = int(np.asarray(jax.device_get(stats))[1])
@@ -476,11 +470,14 @@ class DeviceBFS:
         return res
 
     def _ckpt_ident(self) -> str:
-        """Everything the saved fingerprints/arrays depend on: symmetry
-        mode changes the canonical fingerprints, so it must match too."""
+        """Everything the saved run's soundness depends on: symmetry mode
+        changes the canonical fingerprints, and the INVARIANT SET must
+        match too — states explored before the checkpoint (including Init)
+        were only checked against the original run's invariants, so a
+        resume with different invariants would silently skip them."""
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"
-            f"/sym={self.canon.symmetry}"
+            f"/sym={self.canon.symmetry}/inv={','.join(self.invariants)}"
         )
 
     def _save_checkpoint(

@@ -53,6 +53,8 @@ def parity_gate(
     instance as one arm) or let the gate build both from `model`. The
     two arms must have different chunk geometries — identical geometries
     would make the gate vacuous."""
+    if checkers is None and model is None:
+        raise ValueError("parity_gate requires either `model` or prebuilt `checkers`")
     if checkers is None:
         checkers = tuple(
             DeviceBFS(

@@ -1,0 +1,31 @@
+"""Capacity-growth policy + sorted-set probe shared by the device-resident
+checkers (DeviceBFS and the sharded v2 engine), so a policy fix lands once."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+GROWTH = 4  # enlarge factor per growth step
+HEADROOM = 3  # grow when the next wave could need more than cap/HEADROOM
+I32_MAX = np.int32(2**31 - 1)  # "no violation" sentinel in journal folds
+
+
+def probe_sorted(sorted_arr, vals):
+    """Membership of vals in a sorted u64 array padded with U64_MAX."""
+    pos = jnp.searchsorted(sorted_arr, vals)
+    pos = jnp.clip(pos, 0, sorted_arr.shape[0] - 1)
+    return sorted_arr[pos] == vals
+
+
+def next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:
+    """Smallest growth**k * cap >= needed, rounded up to a multiple of
+    unit, never exceeding max_cap (max_cap is rounded DOWN to a unit
+    multiple so the user's bound is a hard ceiling; cap itself is assumed
+    unit-aligned already)."""
+    eff_max = max(cap, (max_cap // unit) * unit)
+    new = cap
+    while new < needed and new < eff_max:
+        new = min(new * growth, eff_max)
+    new = ((new + unit - 1) // unit) * unit
+    return min(new, eff_max)

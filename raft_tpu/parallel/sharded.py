@@ -1,30 +1,40 @@
-"""Sharded-frontier BFS over a ``jax.sharding.Mesh``.
+"""Sharded-frontier BFS over a ``jax.sharding.Mesh`` (v2).
 
 The TPU-native replacement for TLC's shared-memory worker threads
 (``tlc -workers N``, SURVEY.md §5.8): each chip owns the slice of
-fingerprint space ``fp mod D`` (D = mesh size). A wave is one
-``shard_map``-ed program per chip:
+fingerprint space ``fp mod D`` (D = mesh size). A wave expands the whole
+per-chip frontier by sub-stepping a cursor in ``chunk``-sized chunks; each
+chunk is one ``shard_map``-ed program per chip:
 
-    expand local frontier (vmap) -> fingerprint -> route successors to
-    their owner chip via ``jax.lax.all_to_all`` over ICI -> local
-    sort-unique dedup + probe of the chip-resident seen-set -> append to
-    the local frontier; global termination via ``psum`` of new-state
-    counts.
+    slice `chunk` frontier rows -> expand (vmap over per-action kernels)
+    -> compact valid successor lanes -> canonical fingerprints -> route
+    each candidate to its owner chip (``fp mod D``) via ``jax.lax.
+    all_to_all`` over ICI -> local dedup (sorted seen-set + in-wave
+    buffer probe, first-occurrence) -> append survivors to the local
+    next-frontier and their (parent shard, parent lgid, candidate) rows
+    to the local journal -> batched invariant evaluation folding the
+    first-violating journal index per invariant.
 
-All buffers are fixed-capacity (XLA static shapes); every capacity has an
-overflow flag that aborts the run rather than dropping states. Multi-host
-scale-out is the same collective over DCN (mesh spanning hosts).
+Parent pointers cross shards (a successor's owner is unrelated to its
+parent's shard), so journal entries address states as (shard, local gid);
+the parent shard is implicit in the all-to-all block structure (received
+rows [d*RC:(d+1)*RC] came from chip d) and is never routed.
+
+All buffers are fixed-capacity (XLA static shapes) but GROW between waves
+(4x when a wave ends within 3x of capacity, same policy as DeviceBFS);
+overflow flags abort rather than drop states. Multi-host scale-out is the
+same collective over DCN (mesh spanning hosts).
 
 State counts are exact and deterministic; within-wave discovery ORDER
 differs from the sequential driver (first-occurrence tie-breaking is by
-owner chip), which can pick a different—equally shortest—counterexample.
+owner chip, then source chip), which can pick a different — equally
+shortest — counterexample.
 """
 
 from __future__ import annotations
 
-import math
+import time
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +42,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..checker.util import (
+    GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
+)
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
 
@@ -47,19 +60,45 @@ class ShardedResult:
     violation_invariant: str | None
     seconds: float
     states_per_sec: float
+    terminal: int = 0
+    exhausted: bool = True
+    trace: list[tuple[str, dict]] | None = None
+    metrics: list[dict] | None = None  # per-wave (SURVEY.md §5.5)
 
 
 class ShardedBFS:
+    """Multi-chip exhaustive BFS with per-chip frontier/seen-set/journal.
+
+    Capacities (all per device):
+      chunk          frontier states expanded per chunk step
+      valid_per_state  compaction budget (avg valid successors per state)
+      route_cap      all-to-all slots per (src, dst) pair per chunk step;
+                     defaults to the compaction budget, which makes route
+                     overflow impossible (a chunk yields at most VC
+                     candidates, all of which could share one owner)
+      frontier_cap   per-wave distinct states (grows, multiple of chunk)
+      seen_cap       distinct states owned by the chip (grows)
+      journal_cap    journal rows = owned distinct states beyond Init
+    """
+
+    GROWTH = GROWTH
+    HEADROOM = HEADROOM
+
     def __init__(
         self,
         model,
         invariants: tuple[str, ...] = (),
         symmetry: bool = True,
         devices=None,
-        chunk: int = 256,  # per-device states expanded per wave step
-        route_cap: int | None = None,  # per (src,dst) routed successors
-        frontier_cap: int = 1 << 15,  # per-device frontier buffer
-        seen_cap: int = 1 << 20,  # per-device seen-set capacity
+        chunk: int = 256,
+        valid_per_state: int = 16,
+        route_cap: int | None = None,
+        frontier_cap: int = 1 << 12,
+        seen_cap: int = 1 << 16,
+        journal_cap: int | None = None,
+        max_frontier_cap: int = 1 << 20,
+        max_seen_cap: int = 1 << 24,
+        max_journal_cap: int = 1 << 24,
     ):
         self.model = model
         self.invariants = tuple(invariants)
@@ -68,185 +107,404 @@ class ShardedBFS:
         self.mesh = Mesh(np.array(devices), (AXIS,))
         self.chunk = chunk
         self.A = model.A
-        self.route_cap = route_cap or max(256, (chunk * self.A) // self.D)
-        self.frontier_cap = frontier_cap
-        self.seen_cap = seen_cap
-        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         self.W = model.layout.W
+        self.VC = min(chunk * self.A, chunk * valid_per_state)
+        self.RC = route_cap if route_cap is not None else self.VC
+        frontier_cap = ((frontier_cap + chunk - 1) // chunk) * chunk
+        self.FCAP = frontier_cap
+        self.SCAP = seen_cap
+        self.JCAP = journal_cap if journal_cap is not None else max_journal_cap // 4
+        self.MAX_FCAP = max(max_frontier_cap, frontier_cap)
+        self.MAX_SCAP = max(max_seen_cap, seen_cap)
+        self.MAX_JCAP = max(max_journal_cap, self.JCAP)
+        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
+        self._sharding = NamedSharding(self.mesh, P(AXIS))
 
         spec = P(AXIS)
-        self._wave = jax.jit(
+        self._chunk_fn = jax.jit(
             jax.shard_map(
-                self._wave_local,
+                self._chunk_step,
                 mesh=self.mesh,
-                in_specs=(spec, spec, spec, spec),
-                out_specs=(spec, spec, spec, spec, P(), P()),
-            )
+                in_specs=(spec,) * 10 + (P(), spec),
+                out_specs=(spec,) * 7,
+            ),
+            # donated: next_buf, wave_fps, jps, jpl, jcand, viol, stats
+            # (frontier/fcount/seen are reused across the wave's chunks)
+            donate_argnums=(3, 4, 5, 6, 7, 8, 9),
         )
+        self._finalize_fn = jax.jit(
+            jax.shard_map(
+                self._finalize,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=(spec, spec, spec),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._journals = None  # (jps, jpl, jcand) per shard after run()
+        self._init_by_shard = None
 
-    # ---------- device-local wave (runs per chip under shard_map) ----------
+    # ---------------- device programs (per chip under shard_map) ----------
 
-    def _wave_local(self, frontier, fcount, seen, scount):
-        """frontier [F, W], fcount [1], seen [SC] sorted u64, scount [1].
+    def _chunk_step(
+        self, frontier, fcount, seen, next_buf, wave_fps,
+        jps, jpl, jcand, viol, stats, cursor, base_lgid,
+    ):
+        """One chunk of the current wave on one chip.
 
-        Returns (new_frontier [F, W], new_fcount [1], new_seen [SC],
-        new_scount [1], global_new, flags) where flags packs overflow bits
-        and the index of the first violated invariant (or -1)."""
+        frontier [1,F+1,W]; fcount/base_lgid [1,1]; seen [1,SC] sorted u64;
+        next_buf [1,F+1,W]; wave_fps [1,F+1]; jps/jpl/jcand [1,JC+1];
+        viol [1,K]; stats [1,S] i64 =
+        [wave new, jcount, cum generated, cum terminal, ovf bits, routed lanes].
+        """
         model, D, A, W = self.model, self.D, self.A, self.W
-        F, RC, SC = self.frontier_cap, self.route_cap, self.seen_cap
-        C = self.chunk
-        # shard_map hands us the local block with its leading mesh axis of 1
-        frontier, fcount, seen, scount = frontier[0], fcount[0], seen[0], scount[0]
+        C, VC, RC = self.chunk, self.VC, self.RC
+        F, JC = self.FCAP, self.JCAP
+        # strip the leading local-block axis shard_map hands us
+        frontier, fcount, seen, base_lgid = (
+            frontier[0], fcount[0, 0], seen[0], base_lgid[0, 0])
+        next_buf, wave_fps = next_buf[0], wave_fps[0]
+        jps, jpl, jcand, viol, stats = jps[0], jpl[0], jcand[0], viol[0], stats[0]
 
-        # 1. expand the first `chunk` live states (driver guarantees
-        #    fcount <= chunk per wave by sub-stepping)
-        live = jnp.arange(C) < fcount[0]
-        batch = frontier[:C]
+        # 1. expand `chunk` rows starting at the wave cursor
+        batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
+        live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
         succs, valid, _rank, ovf = jax.vmap(model._expand1)(batch)
         valid = valid & live[:, None]
         expand_ovf = jnp.any(valid & ovf)
-        flat = succs.reshape(C * A, W)
-        fps = self.canon._fingerprints(flat)
-        fps = jnp.where(valid.reshape(-1), fps, U64_MAX)
-        n_generated = jnp.sum(valid)
+        n_gen = jnp.sum(valid)
+        term = jnp.sum(live & ~jnp.any(valid, axis=1))
 
-        # 2. route to owner chip = fp mod D, fixed RC slots per destination
+        # 2. compact the valid lanes (sel[j] = flat lane of the j-th valid)
+        vflat = valid.reshape(-1)
+        vpos = jnp.cumsum(vflat) - 1
+        compact_ovf = n_gen > VC
+        sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+        sel = (
+            jnp.full((VC + 1,), C * A, jnp.int32)
+            .at[sdst]
+            .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+        )
+        selv = sel < C * A
+        flatp = jnp.concatenate(
+            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
+        )
+        flatc = flatp[sel]  # [VC, W]
+        parent_lgid = base_lgid + cursor + sel // A
+        cand = sel % A
+
+        # 3. canonical fingerprints on the compacted lanes
+        fps = self.canon._fingerprints(flatc)
+        fps = jnp.where(selv, fps, U64_MAX)
+
+        # 4. route to owner chip = fp mod D: sort by owner, positional slots
+        payload = jnp.concatenate(
+            [flatc, parent_lgid[:, None], cand[:, None]], axis=1
+        )  # [VC, W+2] i32
         owner = (fps % np.uint64(D)).astype(jnp.int32)
-        owner = jnp.where(fps == U64_MAX, D, owner)  # invalid -> drop lane
+        owner = jnp.where(fps == U64_MAX, D, owner)  # invalid -> drop
         order = jnp.argsort(owner, stable=True)
         owner_s = owner[order]
         fps_s = fps[order]
         start = jnp.searchsorted(owner_s, jnp.arange(D + 1), side="left")
-        pos_in_owner = jnp.arange(C * A) - start[owner_s]
+        pos_in_owner = jnp.arange(VC) - start[owner_s]
         ok = (owner_s < D) & (pos_in_owner < RC)
         route_ovf = jnp.any((owner_s < D) & (pos_in_owner >= RC))
+        n_routed = jnp.sum(ok)
         slot = jnp.where(ok, owner_s * RC + pos_in_owner, D * RC)
-        send_states = jnp.zeros((D * RC + 1, W), jnp.int32).at[slot].set(flat[order])[:-1]
-        send_fps = jnp.full((D * RC + 1,), U64_MAX, jnp.uint64).at[slot].set(fps_s)[:-1]
+        send_pay = jnp.zeros((D * RC + 1, W + 2), jnp.int32).at[slot].set(payload[order])[:-1]
+        send_fps = jnp.full((D * RC + 1,), U64_MAX, jnp.uint64).at[slot].set(
+            jnp.where(ok, fps_s, U64_MAX))[:-1]
 
-        # 3. ICI all-to-all: block d goes to chip d
-        recv_states = lax.all_to_all(send_states, AXIS, 0, 0, tiled=True)
+        # 5. ICI all-to-all: block d of my send goes to chip d; received
+        # block d came from chip d (=> parent shard = recv row // RC)
+        recv_pay = lax.all_to_all(send_pay, AXIS, 0, 0, tiled=True)
         recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
 
-        # 4. local dedup: sort by fp, drop repeats + already-seen
-        sidx = jnp.argsort(recv_fps)
+        # 6. local dedup: seen-set + in-wave buffer + first-occurrence
+        sidx = jnp.argsort(recv_fps, stable=True)
         rf = recv_fps[sidx]
         uniq = jnp.ones_like(rf, dtype=bool).at[1:].set(rf[1:] != rf[:-1])
-        probe = jnp.searchsorted(seen, rf)
-        in_seen = seen[jnp.clip(probe, 0, SC - 1)] == rf
-        newm = uniq & ~in_seen & (rf != U64_MAX)
-        n_new = jnp.sum(newm)
+        in_seen = _probe(seen, rf)
+        in_wave = _probe(wave_fps, rf)
+        new = uniq & ~in_seen & ~in_wave & (rf != U64_MAX)
+        n_new = jnp.sum(new)
 
-        # 5. append to local frontier buffer (compact the survivors first)
-        BUF = max(F, D * RC) + 1  # scatter buffer; last row is the drop lane
-        dst = jnp.where(newm, jnp.cumsum(newm) - 1, BUF - 1)
-        frontier_ovf = n_new > F
-        compact = (
-            jnp.zeros((BUF, W), jnp.int32).at[dst].set(recv_states[sidx])[:F]
-        )
-        new_fps_compact = (
-            jnp.full((BUF,), U64_MAX, jnp.uint64)
-            .at[dst]
-            .set(jnp.where(newm, rf, U64_MAX))[:-1]
-        )
+        # 7. scatter survivors into next frontier + journal
+        ncount = stats[0].astype(jnp.int32)
+        jcount = stats[1].astype(jnp.int32)
+        npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
+        frontier_ovf = ncount + n_new > F
+        journal_ovf = jcount + n_new > JC
+        states_s = recv_pay[sidx, :W]
+        bdst = jnp.where(new, jnp.minimum(ncount + npos, F), F)
+        next_buf = next_buf.at[bdst].set(states_s)
+        jdst = jnp.where(new, jnp.minimum(jcount + npos, JC), JC)
+        jps = jps.at[jdst].set((sidx // RC).astype(jnp.int32))
+        jpl = jpl.at[jdst].set(recv_pay[sidx, W])
+        jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
+        wave_fps = jnp.sort(
+            jnp.concatenate([wave_fps, jnp.where(new, rf, U64_MAX)])
+        )[: F + 1]
 
-        # 6. merge into the seen-set (sorted-array union)
-        seen_ovf = scount[0] + n_new > SC
-        merged = jnp.sort(jnp.concatenate([seen, new_fps_compact]))[:SC]
+        # 8. invariants on the received candidates; fold first-bad jidx
+        jidx = jnp.where(new, jcount + npos, I32_MAX)
+        for k, name in enumerate(self.invariants):
+            okv = model.invariants[name](states_s)
+            bad = new & ~okv
+            viol = viol.at[k].min(jnp.min(jnp.where(bad, jidx, I32_MAX)))
 
-        # 7. invariants on the newly discovered states
-        inv_viol = jnp.int32(-1)
-        if self.invariants:
-            livemask = jnp.arange(F) < n_new
-            for k, name in reversed(list(enumerate(self.invariants))):
-                ok_inv = self.model.invariants[name](compact)
-                bad = jnp.any(~ok_inv & livemask)
-                inv_viol = jnp.where(bad, jnp.int32(k), inv_viol)
-        inv_viol = lax.pmax(inv_viol, AXIS)
-
-        global_new = lax.psum(n_new, AXIS)
-        global_total = lax.psum(n_generated, AXIS)
         ovf_bits = (
-            expand_ovf.astype(jnp.int32)
-            + 2 * route_ovf.astype(jnp.int32)
-            + 4 * frontier_ovf.astype(jnp.int32)
-            + 8 * seen_ovf.astype(jnp.int32)
+            expand_ovf.astype(jnp.int64)
+            + 2 * compact_ovf.astype(jnp.int64)
+            + 4 * route_ovf.astype(jnp.int64)
+            + 8 * frontier_ovf.astype(jnp.int64)
+            + 16 * journal_ovf.astype(jnp.int64)
         )
-        flags = jnp.stack(
-            [lax.pmax(ovf_bits, AXIS), inv_viol, global_new.astype(jnp.int32)]
+        stats = jnp.stack(
+            [
+                stats[0] + n_new,
+                stats[1] + n_new,
+                stats[2] + n_gen,
+                stats[3] + term,
+                stats[4] | ovf_bits,
+                stats[5] + n_routed,
+            ]
         )
         return (
-            compact[None],
-            n_new[None, None].astype(jnp.int32),
-            merged[None],
-            (scount[0] + n_new)[None, None].astype(jnp.int32),
-            global_total.astype(jnp.int64),
-            flags,
+            next_buf[None], wave_fps[None], jps[None], jpl[None],
+            jcand[None], viol[None], stats[None],
         )
 
-    # ---------- host driver ----------
+    def _finalize(self, seen, wave_fps, stats):
+        """End of wave: union wave fingerprints into the seen-set, reset
+        the wave buffer and the per-wave counter."""
+        seen, wave_fps, stats = seen[0], wave_fps[0], stats[0]
+        merged = jnp.sort(jnp.concatenate([seen, wave_fps]))[: self.SCAP]
+        fresh = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
+        stats = stats.at[0].set(0)
+        return merged[None], fresh[None], stats[None]
 
-    def run(self, max_depth: int | None = None, verbose: bool = False) -> ShardedResult:
-        import time
+    # ---------------- capacity growth (between waves, host-mediated) ------
 
-        model, D, W = self.model, self.D, self.W
-        F, SC, C = self.frontier_cap, self.seen_cap, self.chunk
+    def _maybe_grow(self, state, fcounts, scounts, jcounts):
+        """Host-side: fetch, pad, re-place any buffer the next wave could
+        outgrow. Rare (4x growth), so the host round-trip is acceptable;
+        the jitted programs retrace automatically at the new shapes."""
+        ncount = int(fcounts.max())
+        sc = int(scounts.max())
+        jc = int(jcounts.max())
+        D, W = self.D, self.W
+
+        def repad(key, new_rows, old_rows, fill, cols=None):
+            h = np.asarray(jax.device_get(state[key]))
+            shape = (D, new_rows) if cols is None else (D, new_rows, cols)
+            out = np.full(shape, fill, dtype=h.dtype)
+            out[:, :old_rows] = h
+            state[key] = jax.device_put(out, self._sharding)
+
+        if ncount * self.HEADROOM > self.FCAP and self.FCAP < self.MAX_FCAP:
+            new = _next_cap(ncount * self.HEADROOM, self.FCAP, self.MAX_FCAP,
+                            self.GROWTH, self.chunk)
+            repad("frontier", new + 1, self.FCAP + 1, 0, cols=W)
+            state["next_buf"] = jax.device_put(
+                np.zeros((D, new + 1, W), np.int32), self._sharding)
+            state["wave_fps"] = jax.device_put(
+                np.full((D, new + 1), np.uint64(U64_MAX)), self._sharding)
+            self.FCAP = new
+        if sc + ncount * self.HEADROOM > self.SCAP and self.SCAP < self.MAX_SCAP:
+            new = _next_cap(sc + ncount * self.HEADROOM, self.SCAP,
+                            self.MAX_SCAP, self.GROWTH, 1)
+            repad("seen", new, self.SCAP, np.uint64(U64_MAX))
+            self.SCAP = new
+        if jc + ncount * self.HEADROOM > self.JCAP and self.JCAP < self.MAX_JCAP:
+            new = _next_cap(jc + ncount * self.HEADROOM, self.JCAP,
+                            self.MAX_JCAP, self.GROWTH, 1)
+            for key in ("jps", "jpl", "jcand"):
+                repad(key, new + 1, self.JCAP + 1, 0)
+            self.JCAP = new
+        return state
+
+    # ---------------- host driver ----------------
+
+    def run(
+        self,
+        max_depth: int | None = None,
+        verbose: bool = False,
+        time_budget_s: float | None = None,
+        collect_metrics: bool = False,
+    ) -> ShardedResult:
+        model, D, W, C = self.model, self.D, self.W, self.chunk
         t0 = time.perf_counter()
+        exhausted = True
 
-        init = model.init_states()
-        init_fps = np.array(jax.device_get(self.canon.fingerprints(init)), dtype=np.uint64)
-        frontier = np.zeros((D, F, W), np.int32)
-        fcount = np.zeros((D, 1), np.int32)
-        seen = np.full((D, SC), U64_MAX, np.uint64)
-        scount = np.zeros((D, 1), np.int32)
-        for k in range(len(init)):
+        # ---- init states, assigned to owner shards by fp mod D ----
+        init = np.asarray(model.init_states())
+        init_fps = np.asarray(
+            jax.device_get(self.canon.fingerprints(init)), dtype=np.uint64)
+        # dedup inits (first occurrence wins)
+        order = np.argsort(init_fps, kind="stable")
+        keep = np.ones(len(order), dtype=bool)
+        sf = init_fps[order]
+        dupm = np.zeros(len(order), dtype=bool)
+        dupm[1:] = sf[1:] == sf[:-1]
+        keep[order[dupm]] = False
+        init_d, init_fps = init[keep], init_fps[keep]
+
+        frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
+        seen_h = np.full((D, self.SCAP), np.uint64(U64_MAX))
+        fcounts = np.zeros(D, np.int64)
+        self._init_by_shard = [[] for _ in range(D)]
+        for k in range(len(init_d)):
             d = int(init_fps[k] % D)
-            frontier[d, fcount[d, 0]] = init[k]
-            seen[d, fcount[d, 0]] = init_fps[k]
-            fcount[d, 0] += 1
-            scount[d, 0] += 1
-        seen = np.sort(seen, axis=1)
+            frontier_h[d, fcounts[d]] = init_d[k]
+            seen_h[d, fcounts[d]] = init_fps[k]
+            self._init_by_shard[d].append(np.asarray(init_d[k]))
+            fcounts[d] += 1
+        seen_h.sort(axis=1)
+        scounts = fcounts.copy()
+        jcounts = np.zeros(D, np.int64)
+        n0 = fcounts.copy()  # per-shard init count (lgid < n0[d] => init)
+        base_lgid = np.zeros(D, np.int64)
 
-        distinct = len(init)
-        total = len(init)
-        depth_counts = [distinct]
-        depth = 0
         violation = None
-        sharding = NamedSharding(self.mesh, P(AXIS))
-        frontier = jax.device_put(frontier, sharding)
-        fcount = jax.device_put(fcount, sharding)
-        seen = jax.device_put(seen, sharding)
-        scount = jax.device_put(scount, sharding)
+        viol_site = None  # (shard, lgid)
+        init_trace = None  # one-entry trace for a depth-0 violation
+        viol_init = self._check_init(init_d)
+        if viol_init is not None:
+            violation, bad_idx = viol_init
+            init_trace = [("Initial predicate", model.decode(init_d[bad_idx]))]
 
-        while violation is None:
+        state = {
+            "frontier": jax.device_put(frontier_h, self._sharding),
+            "next_buf": jax.device_put(
+                np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
+            "seen": jax.device_put(seen_h, self._sharding),
+            "wave_fps": jax.device_put(
+                np.full((D, self.FCAP + 1), np.uint64(U64_MAX)), self._sharding),
+            "jps": jax.device_put(
+                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+            "jpl": jax.device_put(
+                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+            "jcand": jax.device_put(
+                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+            "viol": jax.device_put(
+                np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
+                self._sharding),
+            "stats": jax.device_put(
+                np.zeros((D, 6), np.int64), self._sharding),
+        }
+
+        distinct = int(len(init_d))
+        total = int(len(init))  # pre-dedup, matching BFSChecker seeding
+        terminal = 0
+        gen_prev = 0
+        routed_prev = 0
+        depth = 0
+        depth_counts = [distinct]
+        metrics: list[dict] | None = [] if collect_metrics else None
+
+        while fcounts.sum() and violation is None:
             if max_depth is not None and depth >= max_depth:
+                exhausted = False
                 break
-            # NOTE v1: one wave expands at most `chunk` states per device;
-            # larger frontiers would need sub-stepping (future work uses a
-            # cursor into the frontier buffer).
-            if int(np.max(np.array(jax.device_get(fcount)))) > C:
-                raise OverflowError(
-                    "per-device frontier exceeds chunk; raise chunk for this model"
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
+                exhausted = False
+                break
+            tw = time.perf_counter()
+            fc_dev = jax.device_put(
+                fcounts.astype(np.int32).reshape(D, 1), self._sharding)
+            bl_dev = jax.device_put(
+                base_lgid.astype(np.int32).reshape(D, 1), self._sharding)
+            max_fc = int(fcounts.max())
+            for cursor in range(0, max_fc, C):
+                (state["next_buf"], state["wave_fps"], state["jps"],
+                 state["jpl"], state["jcand"], state["viol"], state["stats"],
+                 ) = self._chunk_fn(
+                    state["frontier"], fc_dev, state["seen"],
+                    state["next_buf"], state["wave_fps"], state["jps"],
+                    state["jpl"], state["jcand"], state["viol"],
+                    state["stats"], np.int32(cursor), bl_dev,
                 )
-            frontier, fcount, seen, scount, wave_total, flags = self._wave(
-                frontier, fcount, seen, scount
-            )
-            flags_h = np.array(jax.device_get(flags))
-            ovf_bits, inv_idx, global_new = int(flags_h[0]), int(flags_h[1]), int(flags_h[2])
+            stats_h = np.asarray(jax.device_get(state["stats"]))  # [D,6]
+            new_d = stats_h[:, 0]
+            ovf_bits = int(np.bitwise_or.reduce(stats_h[:, 4]))
             if ovf_bits:
-                raise OverflowError(f"sharded BFS capacity overflow (bits={ovf_bits:04b})")
-            total += int(np.array(jax.device_get(wave_total)))
+                raise OverflowError(
+                    f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
+                    "1=msg-slots 2=valid_per_state 4=route_cap "
+                    "8=frontier_cap 16=journal_cap)")
+            if np.any(scounts + new_d > self.SCAP):
+                raise OverflowError("sharded seen-set overflow; raise seen_cap")
+            global_new = int(new_d.sum())
+            n_gen_cum = int(stats_h[:, 2].sum())
+            wave_gen = n_gen_cum - gen_prev
+            total += wave_gen
+            gen_prev = n_gen_cum
+            terminal = int(stats_h[:, 3].sum())
+            wave_routed = int(stats_h[:, 5].sum()) - routed_prev
+            routed_prev = int(stats_h[:, 5].sum())
             if global_new == 0:
                 break
             depth += 1
             distinct += global_new
             depth_counts.append(global_new)
-            if inv_idx >= 0:
-                violation = self.invariants[inv_idx]
-            if verbose:
-                print(f"depth {depth}: +{global_new} distinct={distinct}")
+            base_lgid = n0 + stats_h[:, 1] - new_d
+            scounts += new_d
+            jcounts = stats_h[:, 1].copy()
+            if self.invariants:
+                viol_h = np.asarray(jax.device_get(state["viol"]))  # [D,K]
+                if (viol_h != I32_MAX).any():
+                    # first violated invariant (cfg order), lowest jidx,
+                    # lowest shard as the tie-break
+                    for k, name in enumerate(self.invariants):
+                        col = viol_h[:, k]
+                        if (col != I32_MAX).any():
+                            d = int(np.argmin(col))
+                            violation = name
+                            viol_site = (d, int(n0[d] + col[d]))
+                            break
+            (state["seen"], state["wave_fps"], state["stats"]
+             ) = self._finalize_fn(state["seen"], state["wave_fps"], state["stats"])
+            state["frontier"], state["next_buf"] = (
+                state["next_buf"], state["frontier"])
+            prev_fcounts = fcounts
+            fcounts = new_d.copy()
+            if violation is None:
+                state = self._maybe_grow(state, fcounts, scounts, jcounts)
+            if metrics is not None or verbose:
+                el = time.perf_counter() - t0
+                wm = {
+                    "depth": depth,
+                    "frontier": int(prev_fcounts.sum()),
+                    "new": global_new,
+                    "generated": wave_gen,
+                    "dedup_hit_rate": round(1.0 - global_new / max(1, wave_gen), 4),
+                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "distinct_per_s": round(distinct / el, 1),
+                    "a2a_lanes": wave_routed,
+                    "a2a_bytes": wave_routed * (4 * (W + 2) + 8),
+                    "shard_new": [int(x) for x in new_d],
+                }
+                if metrics is not None:
+                    metrics.append(wm)
+                if verbose:
+                    print(
+                        f"depth {depth}: +{global_new} distinct={distinct} "
+                        f"a2a={wave_routed} lanes "
+                        f"balance={new_d.min()}/{new_d.max()} "
+                        f"({distinct/el:.0f} distinct/s)")
+
+        # fetch journals for trace reconstruction
+        jps_h = np.asarray(jax.device_get(state["jps"]))
+        jpl_h = np.asarray(jax.device_get(state["jpl"]))
+        jcand_h = np.asarray(jax.device_get(state["jcand"]))
+        self._journals = (jps_h, jpl_h, jcand_h, jcounts.copy(), n0.copy())
 
         dt = time.perf_counter() - t0
+        trace = init_trace
+        if violation is not None and viol_site is not None:
+            trace = self.reconstruct_trace(viol_site)
         return ShardedResult(
             distinct=distinct,
             total=total,
@@ -255,4 +513,46 @@ class ShardedBFS:
             violation_invariant=violation,
             seconds=dt,
             states_per_sec=distinct / dt if dt > 0 else 0.0,
+            terminal=terminal,
+            exhausted=exhausted and violation is None,
+            trace=trace,
+            metrics=metrics,
         )
+
+    def _check_init(self, init_d: np.ndarray):
+        """(invariant name, index of first bad init state) or None."""
+        for name in self.invariants:
+            ok = np.asarray(jax.device_get(self.model.invariants[name](init_d)))
+            bad = np.nonzero(~ok)[0]
+            if len(bad):
+                return name, int(bad[0])
+        return None
+
+    # ---------------- trace reconstruction ----------------
+
+    def reconstruct_trace(self, site: tuple[int, int]) -> list[tuple[str, dict]]:
+        """Walk (shard, local gid) parent pointers to an Init state, then
+        replay the recorded candidate actions forward (same semantics as
+        DeviceBFS.reconstruct_trace; journal entries just live per shard)."""
+        model = self.model
+        jps_h, jpl_h, jcand_h, jcounts, n0 = self._journals
+        d, lgid = site
+        chain: list[int] = []
+        while lgid >= n0[d]:
+            j = int(lgid - n0[d])
+            assert j < jcounts[d], "journal index out of range"
+            chain.append(int(jcand_h[d, j]))
+            d, lgid = int(jps_h[d, j]), int(jpl_h[d, j])
+        chain.reverse()
+        state = self._init_by_shard[d][int(lgid)]
+        out = [("Initial predicate", model.decode(state))]
+        expand1 = jax.jit(model._expand1)
+        for cand in chain:
+            succs, valid, rank, _ovf = jax.device_get(expand1(state))
+            assert valid[cand], "journalled candidate not enabled on replay"
+            state = np.asarray(succs[cand])
+            out.append(
+                (model.action_label(int(rank[cand]), cand), model.decode(state)))
+        return out
+
+

@@ -151,6 +151,32 @@ def test_device_bfs_checkpoint_resume(tmp_path):
     assert [a for a, _ in r2.trace] == [a for a, _ in straight.trace]
 
 
+def test_device_bfs_final_checkpoint_on_capped_exit(tmp_path):
+    """A depth/budget-capped run with checkpoint_path must leave a
+    resumable file even when the periodic timer never fired (default
+    300 s cadence on a short run used to produce NO checkpoint at all)."""
+    import os
+
+    ck = str(tmp_path / "final.ckpt.npz")
+    r1 = _device(SMALL, INVS).run(max_depth=3, checkpoint_path=ck)
+    assert not r1.exhausted
+    assert os.path.exists(ck)
+    r2 = _device(SMALL, INVS).run(resume=ck)
+    straight = _device(SMALL, INVS).run()
+    assert r2.distinct == straight.distinct
+    assert r2.depth_counts == straight.depth_counts
+
+
+def test_device_bfs_checkpoint_invariant_mismatch(tmp_path):
+    """Resuming with a different invariant set must be refused: states
+    explored before the checkpoint were never evaluated against the new
+    invariants, so the resumed run's verdict would be unsound."""
+    ck = str(tmp_path / "inv.ckpt.npz")
+    _device(SMALL, INVS).run(max_depth=3, checkpoint_path=ck)
+    with pytest.raises(ValueError, match="checkpoint is for spec"):
+        _device(SMALL, ("NoLogDivergence",)).run(resume=ck)
+
+
 def test_device_bfs_checkpoint_spec_mismatch(tmp_path):
     other = RaftParams(
         n_servers=2, n_values=1, max_elections=1, max_restarts=0, msg_slots=16

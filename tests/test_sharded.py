@@ -1,7 +1,8 @@
-"""Sharded-frontier BFS on a virtual CPU mesh: exact count parity with the
-oracle, and mesh-size robustness. Uses a 2-server model to keep the
-shard_map compile small (the 3-server parity evidence lives in
-test_checker.py's sequential runs)."""
+"""Sharded-frontier BFS v2 on a virtual CPU mesh: exact count parity with
+the oracle (including frontier sub-stepping and capacity growth),
+cross-shard counterexample traces, and mesh-size robustness. Uses small
+models to keep the shard_map compiles fast; the deep 3-server exhaustion
+evidence lives in __graft_entry__.dryrun_multichip (driver-run)."""
 
 import jax
 import numpy as np
@@ -27,16 +28,42 @@ def test_sharded_counts_match_oracle(ndev):
         frontier_cap=1024,
         seen_cap=1 << 12,
     )
-    res = engine.run()
+    res = engine.run(collect_metrics=True)
     oracle = RaftOracle(2, 1, 2, 0)
     ores = oracle.bfs(invariants=(), symmetry=True)
     assert res.violation_invariant is None
+    assert res.exhausted
     assert res.distinct == ores["distinct"]
     assert res.depth == len(ores["depth_counts"]) - 1
     assert res.depth_counts == ores["depth_counts"]
+    # §5.5 metrics: all-to-all volume is reported per wave
+    assert all("a2a_lanes" in m and "a2a_bytes" in m for m in res.metrics)
+    assert sum(m["a2a_lanes"] for m in res.metrics) > 0
 
 
-def test_sharded_detects_violation():
+def test_sharded_substep_and_growth_parity():
+    """Tiny chunk + tiny initial caps force the sub-stepping cursor (wave
+    frontier > chunk) AND between-wave buffer growth; counts must still be
+    exact (round-2 verdict item 3: kill the one-chunk-per-wave cap)."""
+    model = cached_model(PARAMS)
+    engine = ShardedBFS(
+        model,
+        invariants=(),
+        symmetry=True,
+        devices=jax.devices()[:4],
+        chunk=16,  # waves reach width ~100 per shard -> many sub-steps
+        frontier_cap=32,
+        seen_cap=1 << 8,
+        journal_cap=1 << 8,
+    )
+    res = engine.run()
+    ores = RaftOracle(2, 1, 2, 0).bfs(invariants=(), symmetry=True)
+    assert res.distinct == ores["distinct"]
+    assert res.depth_counts == ores["depth_counts"]
+    assert engine.FCAP > 32 or engine.SCAP > (1 << 8)  # growth actually ran
+
+
+def test_sharded_detects_violation_with_trace():
     import jax.numpy as jnp
 
     model = cached_model(PARAMS)
@@ -57,5 +84,12 @@ def test_sharded_detects_violation():
         )
         res = engine.run()
         assert res.violation_invariant == "NoCommit"
+        # v2: the sharded path reconstructs the counterexample trace by
+        # walking cross-shard (shard, lgid) parent pointers and replaying
+        # (replay asserts each journalled candidate is enabled)
+        assert res.trace is not None and len(res.trace) >= 2
+        assert res.trace[0][0] == "Initial predicate"
+        final = res.trace[-1][1]
+        assert any(ci > 0 for ci in final["commitIndex"])
     finally:
         del model.invariants["NoCommit"]
