@@ -276,10 +276,11 @@ def build_reconfig_joint(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
 
 def build_kraft_reconfig(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     """pull-raft/KRaftWithReconfig.tla + its cfg: the dynamic-server
-    universe spec (oracle + simulation backends; its cfg prescribes
-    simulation, KRaftWithReconfig.cfg:5). The cfg shares PullRaft.cfg's
-    latent bug: Value = {v1, v2} with v2 undeclared (lenient repairs)."""
-    from .kraft_reconfig import KRaftReconfigParams, KRaftReconfigSpec
+    universe spec, device-lowered with MaxSpawnedServers identity slots
+    (its cfg prescribes simulation, KRaftWithReconfig.cfg:5). The cfg
+    shares PullRaft.cfg's latent bug: Value = {v1, v2} with v2 undeclared
+    (lenient repairs)."""
+    from .kraft_reconfig import KRaftReconfigParams
 
     hosts = cfg.server_like("Hosts")
     values = cfg.server_like("Value")
@@ -295,8 +296,13 @@ def build_kraft_reconfig(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         max_add_reconfigs=_require_int(cfg, "MaxAddReconfigs"),
         max_remove_reconfigs=_require_int(cfg, "MaxRemoveReconfigs"),
         max_spawned_servers=_require_int(cfg, "MaxSpawnedServers"),
+        msg_slots=msg_slots if msg_slots is not None else 40,
     )
-    model = KRaftReconfigSpec(params, server_names=hosts, value_names=values)
+    # fresh model per setup (names differ per cfg; the lru cache is keyed
+    # on params only, so mutating a cached instance would alias setups)
+    from .kraft_reconfig import KRaftReconfigModel
+
+    model = KRaftReconfigModel(params, server_names=hosts, value_names=values)
     _check_invariants(cfg, model)
     return CheckSetup(
         model=model,

@@ -48,7 +48,15 @@ class Canonicalizer:
     @classmethod
     def for_model(cls, model, symmetry: bool = True) -> "Canonicalizer":
         """Build from a model's declared message-field symmetry contract
-        (keeps the model -> canonicalization plumbing in one place)."""
+        (keeps the model -> canonicalization plumbing in one place).
+
+        A model with data-dependent canonicalization (e.g. the
+        KRaftWithReconfig slot encoding, where a host permutation re-sorts
+        the identity slots) supplies its own via ``make_canonicalizer``;
+        the returned object provides the same ``fingerprints`` /
+        ``_fingerprints`` / ``symmetry`` surface the checkers use."""
+        if hasattr(model, "make_canonicalizer"):
+            return model.make_canonicalizer(symmetry)
         return cls(
             model.layout,
             model.packer,

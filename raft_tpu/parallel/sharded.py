@@ -113,7 +113,9 @@ class ShardedBFS:
         frontier_cap = ((frontier_cap + chunk - 1) // chunk) * chunk
         self.FCAP = frontier_cap
         self.SCAP = seen_cap
-        self.JCAP = journal_cap if journal_cap is not None else max_journal_cap // 4
+        # journal rows ~= owned distinct states, same order as the seen
+        # set; start small and let _maybe_grow enlarge it
+        self.JCAP = journal_cap if journal_cap is not None else seen_cap
         self.MAX_FCAP = max(max_frontier_cap, frontier_cap)
         self.MAX_SCAP = max(max_seen_cap, seen_cap)
         self.MAX_JCAP = max(max_journal_cap, self.JCAP)
