@@ -105,11 +105,41 @@ def main(argv=None):
         print(f"config error: {e}", file=sys.stderr)
         return 64
     symmetry = setup.symmetry and not args.no_symmetry
+    props = tuple(cfg.properties)
     print(
         f"spec={setup.model.name} servers={setup.server_names} "
         f"values={setup.value_names} invariants={list(setup.invariants)} "
-        f"symmetry={symmetry} checker={args.checker}"
+        f"properties={list(props)} symmetry={symmetry} checker={args.checker}"
     )
+    if props:
+        # PROPERTY lines are temporal formulas; refuse configurations this
+        # build cannot check rather than silently dropping them
+        # (round-2 verdict item 5)
+        supported = getattr(setup.model, "liveness", {})
+        unknown = [p for p in props if p not in supported]
+        if unknown:
+            print(
+                f"error: PROPERTY {' '.join(unknown)}: no liveness support "
+                f"for spec {setup.model.name}; remove the PROPERTY line or "
+                "use a supported formula "
+                f"(supported: {', '.join(supported) or 'none'})",
+                file=sys.stderr,
+            )
+            return 64
+        if args.simulate is not None or args.checker == "oracle":
+            print(
+                "error: PROPERTY checking needs the exhaustive device "
+                "graph; run with --checker tpu and no --simulate",
+                file=sys.stderr,
+            )
+            return 64
+        if args.max_depth is not None or args.time_budget is not None:
+            print(
+                "error: PROPERTY checking is unsound on a partially "
+                "explored graph; drop --max-depth/--time-budget",
+                file=sys.stderr,
+            )
+            return 64
 
     if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
         print(
@@ -249,6 +279,34 @@ def main(argv=None):
             print(format_trace(res.trace, setup))
         return 2
     print("no invariant violations")
+
+    if props:
+        from .checker.liveness import LivenessChecker
+
+        live = LivenessChecker(setup.model, props, chunk=args.chunk).run(
+            verbose=args.verbose
+        )
+        print(
+            f"liveness: graph {live.distinct} states / {live.total_edges} "
+            f"edges (symmetry off), properties={list(props)}, "
+            f"{live.seconds:.2f}s"
+        )
+        if live.violation:
+            v = live.violation
+            kind = "terminal stutter" if v.terminal else "cycle"
+            print(
+                f"PROPERTY {v.prop}[{v.instance}] VIOLATED "
+                f"({kind}; prefix {len(v.prefix) - 1} steps, "
+                f"loop {len(v.cycle)} steps)"
+            )
+            from .utils.pprint import format_trace
+
+            print(format_trace(v.prefix, setup))
+            if v.cycle:
+                print("-- loop (repeats forever) --")
+                print(format_trace(v.cycle, setup))
+            return 2
+        print("no temporal property violations")
     return 0
 
 

@@ -1,0 +1,361 @@
+"""Liveness / temporal-property checking under ``WF_vars(Next)``.
+
+The reference defines its liveness formulas against ``LivenessSpec ==
+Init /\\ [][Next]_vars /\\ WF_vars(Next)`` (``Raft.tla:545-550``) in two
+shapes:
+
+  - ``[]<>P``  — "always eventually P" (``ValuesNotStuck``,
+    ``Raft.tla:567-576``; ``ReconfigurationNotStuck``,
+    ``KRaftWithReconfig.tla:1837-1839``);
+  - ``P ~> Q`` — leads-to (``ReconfigurationCompletes``,
+    ``RaftWithReconfigJointConsensus.tla:1039-1054``).
+
+Semantics on a finite fully-explored state graph: a fair behavior under
+weak fairness of the full Next is an infinite path (which must eventually
+loop) or a behavior that reaches a TERMINAL state (no successors — Next
+disabled forever, so stuttering there is fair; ``-deadlock`` semantics,
+reference README.md:7). Therefore
+
+  ``P ~> Q`` is violated  iff  some reachable state satisfies P and from
+  it there is a Q-avoiding path that can avoid Q forever;
+  ``[]<>P``  is the special case ``TRUE ~> P``.
+
+"Can avoid Q forever" is the largest set S of ~Q-states such that every
+member is terminal or has a successor in S — computed by iteratively
+peeling ~Q-states with no exit (a nu-fixpoint; equivalent to "reaches a
+~Q-cycle or ~Q-terminal within the ~Q-subgraph" but needs no SCC
+machinery and is trivially iterative). The counterexample is a lasso:
+Init-prefix to the P-state, a Q-free path into S, and the Q-free cycle
+(or terminal stutter) it sustains.
+
+SYMMETRY note: liveness checking over a symmetry-reduced graph is
+unsound in general (TLC refuses the combination); the graph here is
+always built with symmetry OFF, whatever the cfg declares.
+
+Model contract: ``model.liveness`` maps property name ->
+list of (instance_label, P_kernel_or_None, Q_kernel) — one instance per
+quantified value (``\\A v \\in Value``), P = None meaning ``[]<>Q``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..ops.hashing import hash_lanes
+
+
+@dataclass
+class LivenessViolation:
+    prop: str
+    instance: str
+    prefix: list[tuple[str, dict]]  # Init -> P-state (action label, state)
+    cycle: list[tuple[str, dict]]  # the sustained Q-free loop (or terminal)
+    terminal: bool  # True: lasso "cycle" is a terminal stutter
+
+
+@dataclass
+class LivenessResult:
+    distinct: int
+    total_edges: int
+    properties: tuple[str, ...]
+    violation: LivenessViolation | None
+    seconds: float
+
+
+class LivenessChecker:
+    """Explores the FULL graph (host adjacency, symmetry off) and checks
+    the model's registered temporal properties. Intended for the small
+    bounded configs the reference runs liveness on (``MaxElections = 0``
+    guidance, ``RaftWithReconfigAddRemove.tla:988``); the graph must fit
+    on the host."""
+
+    def __init__(self, model, properties: tuple[str, ...], chunk: int = 512,
+                 max_states: int = 2_000_000):
+        self.model = model
+        self.properties = tuple(properties)
+        self.chunk = chunk
+        self.max_states = max_states
+        unknown = [p for p in self.properties
+                   if p not in getattr(model, "liveness", {})]
+        if unknown:
+            raise ValueError(
+                f"spec {model.name} has no liveness support for: "
+                f"{', '.join(unknown)}"
+            )
+        # FULL-state fingerprints, not the VIEW projection: aux counters
+        # gate actions (electionCtr < MaxElections etc.) and the temporal
+        # predicates read them, so VIEW-merged nodes would conflate states
+        # with different successor structure — unsound for liveness
+        self._fps = jax.jit(lambda v: hash_lanes(v))
+
+    # ---------------- graph construction ----------------
+
+    def _explore(self):
+        model = self.model
+        B, W, A = self.chunk, self.model.layout.W, self.model.A
+        expand = model.expand
+        fps_fn = self._fps
+
+        init = np.asarray(model.init_states())
+        fp0 = np.asarray(jax.device_get(fps_fn(init)), dtype=np.uint64)
+        gid_of: dict[int, int] = {}
+        states: list[np.ndarray] = []
+        for k in range(len(init)):
+            if int(fp0[k]) not in gid_of:
+                gid_of[int(fp0[k])] = len(states)
+                states.append(init[k])
+        frontier = list(range(len(states)))
+        edges_src: list[np.ndarray] = []
+        edges_dst: list[np.ndarray] = []
+        edges_cand: list[np.ndarray] = []
+
+        while frontier:
+            nxt: list[int] = []
+            for off in range(0, len(frontier), B):
+                gids = frontier[off : off + B]
+                batch = np.stack([states[g] for g in gids])
+                nb = len(batch)
+                if nb < B:
+                    batch = np.concatenate(
+                        [batch, np.repeat(batch[-1:], B - nb, axis=0)]
+                    )
+                succs, valid, _rank, ovf = jax.device_get(expand(batch))
+                valid = np.array(valid)  # writable copy
+                valid[nb:] = False
+                if np.any(valid & np.asarray(ovf)):
+                    raise OverflowError("message-slot overflow during liveness graph build")
+                flat = np.asarray(succs).reshape(B * A, W)
+                fps = np.asarray(
+                    jax.device_get(fps_fn(flat)), dtype=np.uint64
+                )
+                vidx = np.nonzero(valid.reshape(-1))[0]
+                src_rows = []
+                dst_rows = []
+                cand_rows = []
+                for fi in vidx:
+                    fp = int(fps[fi])
+                    g2 = gid_of.get(fp)
+                    if g2 is None:
+                        g2 = len(states)
+                        if g2 >= self.max_states:
+                            raise OverflowError(
+                                "liveness graph exceeds max_states; use a "
+                                "smaller config (liveness needs the full graph)"
+                            )
+                        gid_of[fp] = g2
+                        states.append(flat[fi].copy())
+                        nxt.append(g2)
+                    src_rows.append(gids[fi // A])
+                    dst_rows.append(g2)
+                    cand_rows.append(fi % A)
+                if src_rows:
+                    edges_src.append(np.asarray(src_rows, np.int64))
+                    edges_dst.append(np.asarray(dst_rows, np.int64))
+                    edges_cand.append(np.asarray(cand_rows, np.int32))
+            frontier = nxt
+
+        self._states = np.stack(states)
+        self._esrc = np.concatenate(edges_src) if edges_src else np.zeros(0, np.int64)
+        self._edst = np.concatenate(edges_dst) if edges_dst else np.zeros(0, np.int64)
+        self._ecand = np.concatenate(edges_cand) if edges_cand else np.zeros(0, np.int32)
+        self._n_init = len(init)
+
+    def _eval_kernel(self, fn) -> np.ndarray:
+        """Batched predicate over all graph states (padded power-of-two
+        chunks so jit caches a handful of shapes)."""
+        n = len(self._states)
+        out = np.zeros(n, dtype=bool)
+        B = 1 << min(14, max(8, (self.chunk - 1).bit_length()))
+        for off in range(0, n, B):
+            part = self._states[off : off + B]
+            nb = len(part)
+            if nb < B:
+                part = np.concatenate([part, np.repeat(part[-1:], B - nb, axis=0)])
+            out[off : off + nb] = np.asarray(jax.device_get(fn(part)))[:nb]
+        return out
+
+    # ---------------- the nu-fixpoint lasso search ----------------
+
+    def _fwd_adj(self):
+        """CSR forward adjacency (edge order, dst-by-src, row starts);
+        built once per run and cached."""
+        if getattr(self, "_fwd", None) is None:
+            n = len(self._states)
+            order = np.argsort(self._esrc, kind="stable")
+            self._fwd = (
+                order,
+                self._edst[order],
+                np.searchsorted(self._esrc[order], np.arange(n + 1)),
+            )
+        return self._fwd
+
+    def _rev_adj(self):
+        """CSR reverse adjacency (src-by-dst, row starts)."""
+        if getattr(self, "_rev", None) is None:
+            n = len(self._states)
+            order = np.argsort(self._edst, kind="stable")
+            self._rev = (
+                self._esrc[order],
+                np.searchsorted(self._edst[order], np.arange(n + 1)),
+            )
+        return self._rev
+
+    def _sustain_set(self, notq: np.ndarray) -> np.ndarray:
+        """Largest S subset of ~Q with: member is terminal (no successors at
+        all) or has a successor in S. Peeling from the exit count."""
+        n = len(notq)
+        esrc, edst = self._esrc, self._edst
+        # exit_count[s] = #edges s->t with t in S (init: t in ~Q)
+        in_s = notq.copy()
+        live_edge = in_s[edst]
+        exit_count = np.bincount(esrc[live_edge], minlength=n)
+        out_deg = np.bincount(esrc, minlength=n)
+        terminal = out_deg == 0
+        work = list(np.nonzero(in_s & ~terminal & (exit_count == 0))[0])
+        rsorted_src, rstart = self._rev_adj()
+        while work:
+            t = work.pop()
+            if not in_s[t]:
+                continue
+            in_s[t] = False
+            for k in range(rstart[t], rstart[t + 1]):
+                s = rsorted_src[k]
+                if in_s[s] and not terminal[s]:
+                    exit_count[s] -= 1
+                    if exit_count[s] == 0:
+                        work.append(s)
+        return in_s
+
+    def _shortest_path(self, from_set: np.ndarray, to_set: np.ndarray,
+                       within: np.ndarray | None):
+        """BFS (by gid) from any node in from_set to any node in to_set,
+        optionally restricted to `within` nodes; returns list of edge
+        indices, or None."""
+        n = len(self._states)
+        order, ssorted_dst, sstart = self._fwd_adj()
+        prev_edge = np.full(n, -1, np.int64)
+        seen = from_set.copy()
+        if within is not None:
+            seen &= within
+        q = list(np.nonzero(seen)[0])
+        if any(to_set[g] for g in q):
+            g = next(g for g in q if to_set[g])
+            return [], int(g)
+        qi = 0
+        while qi < len(q):
+            s = q[qi]
+            qi += 1
+            for k in range(sstart[s], sstart[s + 1]):
+                t = int(ssorted_dst[k])
+                if seen[t] or (within is not None and not within[t]):
+                    continue
+                seen[t] = True
+                prev_edge[t] = order[k]
+                if to_set[t]:
+                    path = []
+                    cur = t
+                    while prev_edge[cur] >= 0 and not from_set[cur]:
+                        path.append(int(prev_edge[cur]))
+                        cur = int(self._esrc[prev_edge[cur]])
+                    path.reverse()
+                    return path, t
+                q.append(t)
+        return None
+
+    def _decode_path(self, start_gid: int, edge_idxs: list[int]):
+        model = self.model
+        out = []
+        expand1 = jax.jit(model._expand1)  # one jit cache for the whole path
+        for e in edge_idxs:
+            # label via the recorded candidate; re-expand for the rank
+            src = int(self._esrc[e])
+            cand = int(self._ecand[e])
+            succs, valid, rank, _ovf = jax.device_get(
+                expand1(self._states[src])
+            )
+            assert valid[cand]
+            out.append(
+                (model.action_label(int(rank[cand]), cand),
+                 model.decode(np.asarray(self._states[int(self._edst[e])])))
+            )
+        return out
+
+    # ---------------- driver ----------------
+
+    def run(self, verbose: bool = False) -> LivenessResult:
+        t0 = time.perf_counter()
+        self._explore()
+        n = len(self._states)
+        if verbose:
+            print(f"liveness graph: {n} states, {len(self._esrc)} edges")
+        out_deg = np.bincount(self._esrc, minlength=n)
+        violation = None
+        for prop in self.properties:
+            for label, p_fn, q_fn in self.model.liveness[prop]:
+                q = self._eval_kernel(q_fn)
+                p = (
+                    np.ones(n, dtype=bool) if p_fn is None
+                    else self._eval_kernel(p_fn)
+                )
+                sustain = self._sustain_set(~q)
+                starts = p & sustain
+                if not starts.any():
+                    if verbose:
+                        print(f"  {prop}[{label}]: OK")
+                    continue
+                # counterexample lasso
+                init_set = np.zeros(n, dtype=bool)
+                init_set[: self._n_init] = True
+                pre = self._shortest_path(init_set, starts, within=None)
+                assert pre is not None, "violating state must be reachable"
+                pre_edges, s0 = pre
+                # inside S: walk to a terminal or until a gid repeats;
+                # the walk up to the loop entry is counterexample stem
+                walk_edges: list[int] = []
+                term = False
+                order, ssorted_dst, sstart = self._fwd_adj()
+                visited_at: dict[int, int] = {}
+                cur = s0
+                while True:
+                    if out_deg[cur] == 0:
+                        term = True
+                        stem, loop = walk_edges, []
+                        break
+                    if cur in visited_at:
+                        cut = visited_at[cur]
+                        stem, loop = walk_edges[:cut], walk_edges[cut:]
+                        break
+                    visited_at[cur] = len(walk_edges)
+                    nxt = None
+                    for k in range(sstart[cur], sstart[cur + 1]):
+                        t = int(ssorted_dst[k])
+                        if sustain[t]:
+                            nxt = (int(order[k]), t)
+                            break
+                    assert nxt is not None, "sustain set must have an exit"
+                    walk_edges.append(nxt[0])
+                    cur = nxt[1]
+                init_gid = int(self._esrc[pre_edges[0]]) if pre_edges else s0
+                prefix = [
+                    ("Initial predicate",
+                     self.model.decode(np.asarray(self._states[init_gid])))
+                ] + self._decode_path(init_gid, pre_edges + stem)
+                cycle = self._decode_path(s0, loop)
+                violation = LivenessViolation(
+                    prop=prop, instance=label, prefix=prefix, cycle=cycle,
+                    terminal=term,
+                )
+                break
+            if violation:
+                break
+        return LivenessResult(
+            distinct=n,
+            total_edges=len(self._esrc),
+            properties=self.properties,
+            violation=violation,
+            seconds=time.perf_counter() - t0,
+        )

@@ -237,6 +237,16 @@ class RaftModel:
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
+        # temporal properties under WF_vars(Next) (checker/liveness.py):
+        # ValuesNotStuck == \A v : []<> ValueAllOrNothing(v)
+        # (Raft.tla:567-576); []<>Q instances have P = None
+        self.liveness = {
+            "ValuesNotStuck": [
+                (self.value_names[v], None,
+                 jax.jit(partial(self._live_value_all_or_nothing, v)))
+                for v in range(V)
+            ],
+        }
 
     def action_label(self, rank: int, cand: int) -> str:
         """Human label for candidate `cand` whose fired disjunct was `rank`
@@ -828,6 +838,24 @@ class RaftModel:
             (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v, axis=(1, 2)
         )
         return ~bad
+
+    def _live_value_all_or_nothing(self, v, states):
+        """ValueAllOrNothing(v) — Raft.tla:560-573: TRUE when the last
+        permissible election failed with no leader (progress legitimately
+        impossible), else v must be on EVERY server log or on NONE."""
+        lay, L = self.layout, self.p.max_log
+        ec = lay.get(states, "electionCtr")
+        st = lay.get(states, "state")
+        lv = lay.get(states, "log_value")
+        ll = lay.get(states, "log_len")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_log = lanes[None, None, :] < ll[..., None]
+        has_v = jnp.any(in_log & (lv == v + 1), axis=2)  # [B, S]
+        all_have = jnp.all(has_v, axis=1)
+        none_have = ~jnp.any(has_v, axis=1)
+        no_leader = ~jnp.any(st == LEADER, axis=1)
+        spent = ec == self.p.max_elections
+        return (spent & no_leader) | all_have | none_have
 
     def _inv_committed_majority(self, states):
         """CommittedEntriesReachMajority — Raft.tla:625-636."""

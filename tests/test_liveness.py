@@ -1,0 +1,201 @@
+"""Temporal-property checking under WF_vars(Next) (checker/liveness.py).
+
+Differential ground truth: an independent oracle-graph brute force of the
+same fair-behavior semantics (infinite path = lasso, terminal = fair
+stutter), plus planted violations that must produce decodable lassos.
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.checker.liveness import LivenessChecker
+from raft_tpu.models.raft import RaftParams, cached_model
+from raft_tpu.oracle.raft_oracle import RaftOracle
+
+SMALL = RaftParams(n_servers=2, n_values=1, max_elections=2, max_restarts=0, msg_slots=16)
+
+
+def _oracle_graph(o):
+    init = o.init_state()
+    seen = {o.serialize_full(init): 0}
+    states = [init]
+    edges = []
+    i = 0
+    while i < len(states):
+        for _lab, s2 in o.successors(states[i]):
+            k = o.serialize_full(s2)
+            if k not in seen:
+                seen[k] = len(states)
+                states.append(s2)
+            edges.append((i, seen[k]))
+        i += 1
+    return states, edges
+
+
+def _oracle_sustain(states, edges, notq):
+    import collections
+
+    out = collections.defaultdict(list)
+    for s, t in edges:
+        out[s].append(t)
+    in_s = list(notq)
+    changed = True
+    while changed:
+        changed = False
+        for g in range(len(states)):
+            if in_s[g] and out[g] and not any(in_s[t] for t in out[g]):
+                in_s[g] = False
+                changed = True
+    return in_s
+
+
+def test_values_not_stuck_matches_oracle_brute_force():
+    """ValuesNotStuck on the 2-server model: the device full-state graph
+    and violation verdict must match an independent oracle-side check of
+    the same WF semantics (Raft.tla:545-576)."""
+    m = cached_model(SMALL)
+    res = LivenessChecker(m, ("ValuesNotStuck",), chunk=256).run()
+    o = RaftOracle(2, 1, 2, 0)
+    states, edges = _oracle_graph(o)
+
+    def q(st, v):
+        if st["electionCtr"] == o.max_elections and not any(
+            x == "Leader" for x in st["state"]
+        ):
+            return True
+        has = [any(e[1] == v for e in st["log"][i]) for i in range(2)]
+        return all(has) or not any(has)
+
+    sustain = _oracle_sustain(states, edges, [not q(st, 0) for st in states])
+    assert res.distinct == len(states)
+    assert res.total_edges == len(edges)
+    assert (res.violation is not None) == any(sustain)
+    assert res.violation is None  # ValuesNotStuck holds on this config
+
+
+def test_planted_gf_violation_yields_lasso():
+    """[]<>(no value anywhere) is false once a value commits and sticks:
+    the checker must find it and decode a Q-free lasso/stutter."""
+    m = cached_model(SMALL)
+    lay = m.layout
+
+    def never_any_value(states):
+        lv = lay.get(states, "log_value")
+        return jnp.all(lv == 0, axis=(1, 2))
+
+    m.liveness["NeverAnyValue"] = [("v1", None, jax.jit(never_any_value))]
+    try:
+        res = LivenessChecker(m, ("NeverAnyValue",), chunk=256).run()
+    finally:
+        del m.liveness["NeverAnyValue"]
+    v = res.violation
+    assert v is not None and v.prop == "NeverAnyValue"
+    assert v.prefix[0][0] == "Initial predicate"
+    # the sustained suffix really avoids Q: the last prefix state (and the
+    # whole loop, if any) must contain a value in some log
+    tail_states = [v.prefix[-1][1]] + [st for _a, st in v.cycle]
+    for st in tail_states:
+        # decoded entries are (term, value) pairs; any entry is a value
+        assert any(len(lg) > 0 for lg in st["log"])
+
+
+def test_planted_leadsto_violation_exercises_p_path():
+    """(leader exists) ~> FALSE must be violated, with the prefix reaching
+    a state where P holds (the leads-to P != None code path)."""
+    m = cached_model(SMALL)
+    lay = m.layout
+    from raft_tpu.models.raft import LEADER
+
+    def has_leader(states):
+        return jnp.any(lay.get(states, "state") == LEADER, axis=1)
+
+    def never(states):
+        return jnp.zeros(states.shape[:-1], dtype=bool)
+
+    m.liveness["LeaderDoom"] = [("", jax.jit(has_leader), jax.jit(never))]
+    try:
+        res = LivenessChecker(m, ("LeaderDoom",), chunk=256).run()
+    finally:
+        del m.liveness["LeaderDoom"]
+    v = res.violation
+    assert v is not None
+    # P (a leader exists) holds at the start of the sustained suffix —
+    # somewhere on the prefix (the stem then continues inside ~Q); the
+    # decoded state field carries the numeric enum
+    assert any(
+        any(s == LEADER for s in st["state"]) for _a, st in v.prefix
+    )
+
+
+def test_unknown_property_refused():
+    m = cached_model(SMALL)
+    with pytest.raises(ValueError, match="no liveness support"):
+        LivenessChecker(m, ("NoSuchProperty",))
+
+
+def _run_cli(cfg_text, tmp_path, *extra):
+    cfg = tmp_path / "Raft.cfg"
+    cfg.write_text(cfg_text)
+    return subprocess.run(
+        [sys.executable, "-m", "raft_tpu", str(cfg), "--platform", "cpu",
+         "--msg-slots", "16", *extra],
+        capture_output=True, text=True, timeout=900,
+    )
+
+
+RAFT_LIVE_CFG = """\
+CONSTANTS
+    n1 = n1
+    n2 = n2
+    v1 = v1
+    Server = { n1, n2 }
+    Value = { v1 }
+    Follower = Follower
+    Candidate = Candidate
+    Leader = Leader
+    Nil = Nil
+    RequestVoteRequest = RequestVoteRequest
+    RequestVoteResponse = RequestVoteResponse
+    AppendEntriesRequest = AppendEntriesRequest
+    AppendEntriesResponse = AppendEntriesResponse
+    EqualTerm = EqualTerm
+    LessOrEqualTerm = LessOrEqualTerm
+    MaxElections = 1
+    MaxRestarts = 0
+
+INIT Init
+NEXT Next
+
+PROPERTY
+ValuesNotStuck
+
+INVARIANT
+NoLogDivergence
+"""
+
+
+def test_cli_property_clean_pass(tmp_path):
+    """Raft spec with PROPERTY ValuesNotStuck enabled: safety BFS then a
+    clean liveness pass over the full-state graph."""
+    r = _run_cli(RAFT_LIVE_CFG, tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no temporal property violations" in r.stdout
+
+
+def test_cli_property_refusals(tmp_path):
+    # unknown property -> refused, not dropped
+    r = _run_cli(RAFT_LIVE_CFG.replace("ValuesNotStuck", "NoSuchProp"), tmp_path)
+    assert r.returncode == 64
+    assert "no liveness support" in r.stderr
+    # partial exploration -> refused (liveness needs the full graph)
+    r = _run_cli(RAFT_LIVE_CFG, tmp_path, "--max-depth", "3")
+    assert r.returncode == 64
+    assert "unsound" in r.stderr
+    # oracle backend -> refused
+    r = _run_cli(RAFT_LIVE_CFG, tmp_path, "--checker", "oracle")
+    assert r.returncode == 64
