@@ -48,6 +48,15 @@ def main(argv=None):
     ap.add_argument("--max-journal-cap", type=int, default=None,
                     help="journal growth bound (tpu checker)")
     ap.add_argument("--max-depth", type=int, default=None)
+    ap.add_argument(
+        "--collision-audit",
+        type=int,
+        default=None,
+        metavar="DEPTH",
+        help="before the main run, explore to DEPTH under two independent "
+        "fingerprint hash families and require identical counts (bounds "
+        "silent hash-collision risk; tpu checker only)",
+    )
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
     ap.add_argument(
         "--simulate",
@@ -140,6 +149,29 @@ def main(argv=None):
                 file=sys.stderr,
             )
             return 64
+
+    if args.collision_audit is not None:
+        if args.checker != "tpu" or args.simulate is not None:
+            print(
+                "error: --collision-audit needs --checker tpu and no "
+                "--simulate (the audit re-runs the exhaustive BFS)",
+                file=sys.stderr,
+            )
+            return 64
+        from .checker.audit import collision_audit
+
+        audit = collision_audit(
+            setup.model, invariants=setup.invariants, symmetry=symmetry,
+            depth=args.collision_audit, chunk=args.chunk,
+        )
+        print(audit)
+        if not audit.ok:
+            print(
+                "error: fingerprint-collision audit failed — counts differ "
+                "between hash families; results would be untrustworthy",
+                file=sys.stderr,
+            )
+            return 70
 
     if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
         print(

@@ -80,6 +80,7 @@ class DeviceBFS:
         max_frontier_cap: int = 1 << 22,
         max_seen_cap: int = 1 << 25,
         max_journal_cap: int = 1 << 25,
+        fingerprint_seed: int = 0,
     ):
         self.model = model
         self.invariants = tuple(invariants)
@@ -100,7 +101,9 @@ class DeviceBFS:
         # unclamped cursor, skipping tail states); requiring divisibility
         # keeps every slice in bounds
         assert frontier_cap % chunk == 0, "frontier_cap must be a multiple of chunk"
-        self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
+        self.canon = Canonicalizer.for_model(
+            model, symmetry=symmetry, seed=fingerprint_seed
+        )
         # donated: next_buf, wave_fps, jparent, jcand, viol, stats
         self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=(2, 3, 4, 5, 6, 7))
         self._finalize_fn = jax.jit(self._finalize, donate_argnums=(0, 1, 2))
@@ -477,7 +480,8 @@ class DeviceBFS:
         resume with different invariants would silently skip them."""
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"
-            f"/sym={self.canon.symmetry}/inv={','.join(self.invariants)}"
+            f"/sym={self.canon.symmetry}/seed={self.canon.seed}"
+            f"/inv={','.join(self.invariants)}"
         )
 
     def _save_checkpoint(

@@ -230,17 +230,13 @@ class LivenessChecker:
                         work.append(s)
         return in_s
 
-    def _shortest_path(self, from_set: np.ndarray, to_set: np.ndarray,
-                       within: np.ndarray | None):
-        """BFS (by gid) from any node in from_set to any node in to_set,
-        optionally restricted to `within` nodes; returns list of edge
-        indices, or None."""
+    def _shortest_path(self, from_set: np.ndarray, to_set: np.ndarray):
+        """BFS (by gid) from any node in from_set to any node in to_set;
+        returns (list of edge indices, target gid), or None."""
         n = len(self._states)
         order, ssorted_dst, sstart = self._fwd_adj()
         prev_edge = np.full(n, -1, np.int64)
         seen = from_set.copy()
-        if within is not None:
-            seen &= within
         q = list(np.nonzero(seen)[0])
         if any(to_set[g] for g in q):
             g = next(g for g in q if to_set[g])
@@ -251,7 +247,7 @@ class LivenessChecker:
             qi += 1
             for k in range(sstart[s], sstart[s + 1]):
                 t = int(ssorted_dst[k])
-                if seen[t] or (within is not None and not within[t]):
+                if seen[t]:
                     continue
                 seen[t] = True
                 prev_edge[t] = order[k]
@@ -269,7 +265,9 @@ class LivenessChecker:
     def _decode_path(self, start_gid: int, edge_idxs: list[int]):
         model = self.model
         out = []
-        expand1 = jax.jit(model._expand1)  # one jit cache for the whole path
+        if getattr(self, "_expand1_jit", None) is None:
+            self._expand1_jit = jax.jit(model._expand1)  # one cache per checker
+        expand1 = self._expand1_jit
         for e in edge_idxs:
             # label via the recorded candidate; re-expand for the rank
             src = int(self._esrc[e])
@@ -310,7 +308,7 @@ class LivenessChecker:
                 # counterexample lasso
                 init_set = np.zeros(n, dtype=bool)
                 init_set[: self._n_init] = True
-                pre = self._shortest_path(init_set, starts, within=None)
+                pre = self._shortest_path(init_set, starts)
                 assert pre is not None, "violating state must be reachable"
                 pre_edges, s0 = pre
                 # inside S: walk to a terminal or until a gid repeats;

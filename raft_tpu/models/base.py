@@ -99,3 +99,33 @@ class Layout:
 
     def zeros(self, batch: tuple[int, ...] = ()) -> np.ndarray:
         return np.zeros(batch + (self.W,), dtype=np.int32)
+
+
+def messages_are_valid_kernel(layout: Layout, packer):
+    """MessagesAreValid — MessagePassing.tla:81-83: no record in the bag
+    domain is self-addressed (msource = mdest). A checker self-check
+    (SURVEY.md §5.2): the spec never sends to self, so a violation means
+    the lowering (not the protocol) corrupted a key. Works for both the
+    2-word BitPacker (msg_hi/msg_lo) and N-word WidePacker (msg_w*) bag
+    layouts; batched over [..., W] states."""
+    import jax.numpy as jnp
+
+    from ..ops.packing import EMPTY, WidePacker
+
+    wide = [f.name for f in layout.fields.values() if f.kind == "msg_word"]
+
+    def kernel(states):
+        if isinstance(packer, WidePacker):
+            words = tuple(layout.get(states, n) for n in wide)
+            occ = words[0] != EMPTY
+            src = packer.unpack(words, "msource")
+            dst = packer.unpack(words, "mdest")
+        else:
+            hi = layout.get(states, "msg_hi")
+            lo = layout.get(states, "msg_lo")
+            occ = hi != EMPTY
+            src = packer.unpack(hi, lo, "msource")
+            dst = packer.unpack(hi, lo, "mdest")
+        return ~jnp.any(occ & (src == dst), axis=-1)
+
+    return kernel

@@ -35,7 +35,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout
+from .base import Layout, messages_are_valid_kernel
 
 # state[i] enum, shared with oracle/kraft_oracle.py (KRaft.tla:69,87)
 UNATTACHED, VOTED, FOLLOWER, CANDIDATE, LEADER, ILLEGAL = range(6)
@@ -227,6 +227,9 @@ class KRaftModel:
 
         self.expand = jax.jit(jax.vmap(self._expand1))
         self.invariants = {
+            "MessagesAreValid": jax.jit(
+                messages_are_valid_kernel(self.layout, self.packer)
+            ),
             "NoIllegalState": jax.jit(self._inv_no_illegal),
             "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
             "NeverTwoLeadersInSameEpoch": jax.jit(self._inv_never_two_leaders),

@@ -334,8 +334,8 @@ class KRaftReconfigModel:
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
 
-    def make_canonicalizer(self, symmetry: bool = True) -> "SlotCanonicalizer":
-        return SlotCanonicalizer(self, symmetry)
+    def make_canonicalizer(self, symmetry: bool = True, seed: int = 0) -> "SlotCanonicalizer":
+        return SlotCanonicalizer(self, symmetry, seed=seed)
 
     def action_label(self, rank: int, cand: int) -> str:
         name, binding = self.bindings[cand]
@@ -1941,9 +1941,11 @@ class SlotCanonicalizer:
     order for unpermuted states), kept for uniformity.
     """
 
-    def __init__(self, model: KRaftReconfigModel, symmetry: bool = True):
+    def __init__(self, model: KRaftReconfigModel, symmetry: bool = True,
+                 seed: int = 0):
         self.model = model
         self.symmetry = symmetry
+        self.seed = seed
         H, V = model.p.n_hosts, model.p.n_values
         if symmetry:
             sigmas = list(itertools.permutations(range(H)))
@@ -2047,7 +2049,7 @@ class SlotCanonicalizer:
         upd["msg_cnt"] = scnt
 
         out = model._asm(d, **upd)
-        return hash_lanes(out[: model.layout.view_len])
+        return hash_lanes(out[: model.layout.view_len], seed=self.seed)
 
 
 @lru_cache(maxsize=None)

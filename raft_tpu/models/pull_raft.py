@@ -31,7 +31,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout
+from .base import Layout, messages_are_valid_kernel
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 NIL = 0  # leader/votedFor Nil; server i stored as i+1
@@ -194,6 +194,9 @@ class PullRaftModel:
 
         self.expand = jax.jit(jax.vmap(self._expand1))
         self.invariants = {
+            "MessagesAreValid": jax.jit(
+                messages_are_valid_kernel(self.layout, self.packer)
+            ),
             "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
             "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),

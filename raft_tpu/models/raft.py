@@ -29,7 +29,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout
+from .base import Layout, messages_are_valid_kernel
 
 # state[i] encoding (CONSTANTS Follower/Candidate/Leader, Raft.tla:38)
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -232,6 +232,9 @@ class RaftModel:
 
         self.expand = jax.jit(jax.vmap(self._expand1))
         self.invariants = {
+            "MessagesAreValid": jax.jit(
+                messages_are_valid_kernel(self.layout, self.packer)
+            ),
             "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
             "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),

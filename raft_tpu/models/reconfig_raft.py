@@ -39,7 +39,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, WidePacker, bits_for
-from .base import Layout
+from .base import Layout, messages_are_valid_kernel
 
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
 NIL = 0
@@ -289,6 +289,9 @@ class ReconfigRaftModel:
 
         self.expand = jax.jit(jax.vmap(self._expand1))
         self.invariants = {
+            "MessagesAreValid": jax.jit(
+                messages_are_valid_kernel(self.layout, self.packer)
+            ),
             "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
             "MaxOneReconfigurationAtATime": jax.jit(self._inv_max_one_reconfig),
             "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),

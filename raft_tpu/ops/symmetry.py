@@ -46,7 +46,7 @@ from ..models.base import Layout
 
 class Canonicalizer:
     @classmethod
-    def for_model(cls, model, symmetry: bool = True) -> "Canonicalizer":
+    def for_model(cls, model, symmetry: bool = True, seed: int = 0) -> "Canonicalizer":
         """Build from a model's declared message-field symmetry contract
         (keeps the model -> canonicalization plumbing in one place).
 
@@ -56,7 +56,7 @@ class Canonicalizer:
         the returned object provides the same ``fingerprints`` /
         ``_fingerprints`` / ``symmetry`` surface the checkers use."""
         if hasattr(model, "make_canonicalizer"):
-            return model.make_canonicalizer(symmetry)
+            return model.make_canonicalizer(symmetry, seed=seed)
         return cls(
             model.layout,
             model.packer,
@@ -66,6 +66,7 @@ class Canonicalizer:
             msg_server_nil_fields=getattr(model, "msg_server_nil_fields", ()),
             msg_perm_spec=getattr(model, "msg_perm_spec", None),
             symmetry=symmetry,
+            seed=seed,
         )
 
     def __init__(
@@ -76,6 +77,7 @@ class Canonicalizer:
         msg_server_nil_fields: tuple[str, ...] = (),
         msg_perm_spec: tuple[tuple[str, str], ...] | None = None,
         symmetry: bool = True,
+        seed: int = 0,
     ):
         S = layout.n_servers
         VL = layout.view_len
@@ -83,6 +85,9 @@ class Canonicalizer:
         self.layout = layout
         self.packer = packer
         self.symmetry = symmetry
+        # fingerprint hash seed: a second independent hash family for the
+        # collision audit (checker/audit.py)
+        self.seed = seed
         # Unified remap spec: (packed field, kind) with kind one of
         #   server          plain server index (msource/mdest)
         #   server_nil      0 = Nil, i+1 = server i (KRaft mleader)
@@ -207,7 +212,7 @@ class Canonicalizer:
             for sl, arr in zip(self._msg_word_sls, sorted_all[:-1]):
                 v = v.at[:, sl].set(arr)
             v = v.at[:, self._msg_cnt_sl].set(sorted_all[-1])
-        return hash_lanes(v)
+        return hash_lanes(v, seed=self.seed)
 
     def _fingerprints(self, states):
         """[B, W] int32 -> uint64 [B] canonical fingerprints."""
