@@ -150,6 +150,14 @@ def main(argv=None):
             )
             return 64
 
+    if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
+        print(
+            f"error: spec {setup.model.name} has no TPU lowering yet; use "
+            "--checker oracle (exhaustive or --simulate)",
+            file=sys.stderr,
+        )
+        return 64
+
     if args.collision_audit is not None:
         if args.checker != "tpu" or args.simulate is not None:
             print(
@@ -160,9 +168,21 @@ def main(argv=None):
             return 64
         from .checker.audit import collision_audit
 
+        audit_caps = {
+            k: v
+            for k, v in {
+                "frontier_cap": args.frontier_cap,
+                "seen_cap": args.seen_cap,
+                "journal_cap": args.journal_cap,
+                "max_frontier_cap": args.max_frontier_cap,
+                "max_seen_cap": args.max_seen_cap,
+                "max_journal_cap": args.max_journal_cap,
+            }.items()
+            if v is not None
+        }
         audit = collision_audit(
             setup.model, invariants=setup.invariants, symmetry=symmetry,
-            depth=args.collision_audit, chunk=args.chunk,
+            depth=args.collision_audit, chunk=args.chunk, **audit_caps,
         )
         print(audit)
         if not audit.ok:
@@ -172,14 +192,6 @@ def main(argv=None):
                 file=sys.stderr,
             )
             return 70
-
-    if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
-        print(
-            f"error: spec {setup.model.name} has no TPU lowering yet; use "
-            "--checker oracle (exhaustive or --simulate)",
-            file=sys.stderr,
-        )
-        return 64
 
     if args.checker == "oracle" and args.simulate is not None:
         from .models.registry import oracle_for_setup

@@ -51,9 +51,11 @@ def collision_audit(
     frontier_cap: int | None = None,
     seen_cap: int = 1 << 20,
     journal_cap: int = 1 << 20,
+    **caps,
 ) -> AuditResult:
     """Explore to `depth` under two hash seeds; identical depth_counts/
-    total/terminal => audit passes."""
+    total/terminal => audit passes. Extra **caps (max_*_cap) forward to
+    DeviceBFS so a CLI-tuned geometry audits at its own sizes."""
     assert seeds[0] != seeds[1], "audit needs two distinct hash families"
     if frontier_cap is None:  # smallest chunk-multiple >= 1<<16
         frontier_cap = ((max(1 << 16, chunk) + chunk - 1) // chunk) * chunk
@@ -62,7 +64,7 @@ def collision_audit(
         ck = DeviceBFS(
             model, invariants=invariants, symmetry=symmetry, chunk=chunk,
             frontier_cap=frontier_cap, seen_cap=seen_cap,
-            journal_cap=journal_cap, fingerprint_seed=seed,
+            journal_cap=journal_cap, fingerprint_seed=seed, **caps,
         )
         runs.append(ck.run(max_depth=depth))
     a, b = runs

@@ -38,7 +38,9 @@ from jax import lax
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
-from .util import GROWTH, HEADROOM, I32_MAX, next_cap, probe_sorted as _probe
+from .util import (
+    GROWTH, HEADROOM, I32_MAX, merge_sorted, next_cap, probe_sorted as _probe,
+)
 
 
 class DeviceBFS:
@@ -173,9 +175,11 @@ class DeviceBFS:
         jdst = jnp.where(new, jnp.minimum(jcount + npos, JCAP), JCAP)
         jparent = jparent.at[jdst].set(base_gid + cursor + sel // A)
         jcand = jcand.at[jdst].set(sel % A)
-        wave_fps = jnp.sort(
-            jnp.concatenate([wave_fps, jnp.where(new, fps, U64_MAX)])
-        )[: FCAP + 1]
+        # sort only the VC new candidates, then linear-merge into the
+        # (already sorted) wave buffer: a full re-sort of FCAP+VC lanes
+        # per chunk dominated wave time at large frontiers
+        new_sorted = jnp.sort(jnp.where(new, fps, U64_MAX))
+        wave_fps = merge_sorted(wave_fps, new_sorted)[: FCAP + 1]
 
         # 6. invariants on the compacted candidates; fold first-bad gid
         jidx = jnp.where(new, jcount + npos, I32_MAX)
@@ -202,9 +206,11 @@ class DeviceBFS:
         return next_buf, wave_fps, jparent, jcand, viol, stats
 
     def _finalize(self, seen, wave_fps, stats):
-        """End of wave: union the wave fingerprints into the seen-set and
-        reset the wave buffer + wave counter."""
-        merged = jnp.sort(jnp.concatenate([seen, wave_fps]))[: self.SCAP]
+        """End of wave: union the wave fingerprints into the seen-set
+        (linear merge of two sorted arrays; the truncated tail is always
+        U64_MAX padding because the host checks scount+ncount <= SCAP
+        before finalizing) and reset the wave buffer + wave counter."""
+        merged = merge_sorted(seen, wave_fps)[: self.SCAP]
         fresh = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
         stats = stats.at[0].set(0)
         return merged, fresh, stats

@@ -1,0 +1,320 @@
+"""Shared action/invariant core of the two reconfiguration Raft lowerings.
+
+``RaftWithReconfigJointConsensus.tla`` and ``RaftWithReconfigAddRemove.tla``
+share their non-reconfig machinery almost verbatim (the reference itself
+copy-inlines it); ``models/joint_raft.py`` and ``models/reconfig_raft.py``
+mirrored that, leaving ~1k duplicated lines where a shared-action fix had
+to land twice (round-2 verdict Weak #8). This mixin holds the common
+kernels once, parameterized by three class attributes the variants set:
+
+  ENTRY_FIELDS   log-entry lane suffixes (``log_{n}`` layout fields and
+                 ``e_{n}`` / ``l{k}_{n}`` packed message fields)
+  CMD_APPEND     the AppendCommand enum value (the two specs number their
+                 command sets differently)
+  ACTION_NAMES   Next-disjunct labels for traces
+
+The shared Next-disjunct RANKS are identical by construction in both
+specs (verified by asserts in each variant module): positions 0-11 for
+the core-Raft actions and 14-16 for the snapshot trio.
+
+Everything genuinely variant-specific — dual old/new quorums vs.
+member-set quorums, reconfig append actions, LogOk strictness, the fused
+receipt kernel — stays in the variant modules.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import bag
+
+# enums shared by both variants (identical values in both specs' lowerings)
+FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
+NIL = 0
+ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
+RVREQ, RVRESP, AEREQ, AERESP, SNAPREQ, SNAPRESP = 1, 2, 3, 4, 5, 6
+PENDING_SNAP_REQUEST = -1  # JointConsensus :293 / AddRemove :271
+PENDING_SNAP_RESPONSE = -2
+
+# shared Next-disjunct ranks (both variants lay their Next out so these
+# land at the same indices; asserted in the variant modules)
+(
+    R_RESTART,
+    R_UPDATETERM,
+    R_REQUESTVOTE,
+    R_BECOMELEADER,
+    R_HANDLE_RVREQ,
+    R_HANDLE_RVRESP,
+    R_CLIENTREQUEST,
+    R_ADVANCECOMMIT,
+    R_APPENDENTRIES,
+    R_REJECT_AE,
+    R_ACCEPT_AE,
+    R_HANDLE_AERESP,
+) = range(12)
+R_SENDSNAP, R_HANDLE_SNAPREQ, R_HANDLE_SNAPRESP = 14, 15, 16
+
+
+class ConfigRaftCommon:
+    """Mixin with the kernels common to both reconfig lowerings.
+
+    Subclass contract: ``self.p`` (params with n_servers/max_log/
+    max_term/max_elections/max_restarts/max_values_per_term/n_values),
+    ``self.layout``/``self.packer``/``self.n_words``/``self.bindings``,
+    layout fields named as in the variants (``config_members``,
+    ``log_{n}`` for n in ENTRY_FIELDS, ...), and the three class attrs
+    documented in the module docstring."""
+
+    ENTRY_FIELDS: tuple[str, ...]
+    CMD_APPEND: int
+    ACTION_NAMES: list[str]
+
+    def action_label(self, rank: int, cand: int) -> str:
+        name, binding = self.bindings[cand]
+        if name == "HandleMessage":
+            return f"{self.ACTION_NAMES[rank]}(slot {binding[0]})"
+        return f"{name}{binding}"
+
+    # ---------------- field access helpers ----------------
+
+    def _dec(self, s):
+        g = self.layout.get
+        return {f: g(s, f) for f in self.layout.fields}
+
+    def _asm(self, d, **updates):
+        parts = []
+        for name, f in self.layout.fields.items():
+            arr = updates.get(name, d[name])
+            arr = jnp.asarray(arr, jnp.int32)
+            parts.append(arr.reshape(-1) if f.shape else arr.reshape(1))
+        return jnp.concatenate(parts)
+
+    def _pack(self, **vals):
+        return tuple(jnp.asarray(w, jnp.int32) for w in self.packer.pack(**vals))
+
+    def _words(self, d):
+        return [d[f"msg_w{k}"] for k in range(self.n_words)]
+
+    def _bag_put(self, words, cnt, key):
+        return bag.wide_bag_put(words, cnt, key)
+
+    def _word_upd(self, words, cnt):
+        upd = {f"msg_w{k}": w for k, w in enumerate(words)}
+        upd["msg_cnt"] = cnt
+        return upd
+
+    @staticmethod
+    def _last_term(d, i):
+        """LastTerm — JointConsensus :252 / AddRemove :173."""
+        ll = d["log_len"][i]
+        return jnp.where(ll > 0, d["log_term"][i][jnp.clip(ll - 1, 0)], 0)
+
+    @staticmethod
+    def _popcount(x, S):
+        return jnp.sum((x >> jnp.arange(S, dtype=jnp.int32)) & 1)
+
+    # ---------------- shared action kernels ----------------
+
+    def _restart(self, s, i):
+        """Restart(i) — JointConsensus :362-374 / AddRemove :346-358:
+        keeps config, currentTerm, votedFor, log."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        valid = d["restartCtr"] < p.max_restarts
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(FOLLOWER),
+            votesGranted=d["votesGranted"].at[i].set(0),
+            nextIndex=d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
+            matchIndex=d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            pendingResponse=d["pendingResponse"].at[i].set(0),
+            commitIndex=d["commitIndex"].at[i].set(0),
+            restartCtr=d["restartCtr"] + 1,
+        )
+        return valid, succ, jnp.int32(R_RESTART), jnp.asarray(False)
+
+    def _request_vote(self, s, i):
+        """RequestVote(i) — JointConsensus :431-450 / AddRemove :425-444:
+        member-only; RequestVoteRequests to the member set via
+        SendMultipleOnce."""
+        p, S = self.p, self.p.n_servers
+        d = self._dec(s)
+        st_i = d["state"][i]
+        members = d["config_members"][i]
+        valid = (
+            (d["electionCtr"] < p.max_elections)
+            & ((st_i == FOLLOWER) | (st_i == CANDIDATE))
+            & (((members >> i) & 1) > 0)
+        )
+        new_term = d["currentTerm"][i] + 1
+        last_t = self._last_term(d, i)
+        ll_i = d["log_len"][i]
+        words, cnt = self._words(d), d["msg_cnt"]
+        ovf = jnp.asarray(False)
+        for delta in range(1, S):
+            j = jnp.mod(i + delta, S)
+            is_member = ((members >> j) & 1) > 0
+            key = self._pack(
+                mtype=RVREQ,
+                mterm=new_term,
+                mlastLogTerm=last_t,
+                mlastLogIndex=ll_i,
+                msource=i,
+                mdest=j,
+            )
+            w2, c2, existed, o = self._bag_put(words, cnt, key)
+            valid &= (~is_member) | ~existed  # SendMultipleOnce
+            ovf |= is_member & o
+            words = [jnp.where(is_member, a, b) for a, b in zip(w2, words)]
+            cnt = jnp.where(is_member, c2, cnt)
+        succ = self._asm(
+            d,
+            state=d["state"].at[i].set(CANDIDATE),
+            currentTerm=d["currentTerm"].at[i].set(new_term),
+            votedFor=d["votedFor"].at[i].set(i + 1),
+            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
+            electionCtr=d["electionCtr"] + 1,
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(R_REQUESTVOTE), ovf & valid
+
+    def _client_request(self, s, i, v):
+        """ClientRequest(i, v) — JointConsensus :535-550 / AddRemove
+        :525-540 (acked gate + per-term valueCtr)."""
+        p, L = self.p, self.p.max_log
+        d = self._dec(s)
+        term = d["currentTerm"][i]
+        tpos = jnp.clip(term - 1, 0, p.max_term - 1)
+        valid = (
+            (d["state"][i] == LEADER)
+            & (d["acked"][v] == ACK_NIL)
+            & (d["valueCtr"][tpos] < p.max_values_per_term)
+        )
+        pos = d["log_len"][i]
+        ovf = valid & (pos >= L)
+        posc = jnp.clip(pos, 0, L - 1)
+        succ = self._asm(
+            d,
+            log_term=d["log_term"].at[i, posc].set(term),
+            log_cmd=d["log_cmd"].at[i, posc].set(self.CMD_APPEND),
+            log_val=d["log_val"].at[i, posc].set(v + 1),
+            log_len=d["log_len"].at[i].add(1),
+            acked=d["acked"].at[v].set(ACK_FALSE),
+            valueCtr=d["valueCtr"].at[tpos].add(1),
+        )
+        return valid, succ, jnp.int32(R_CLIENTREQUEST), ovf
+
+    def _append_entries(self, s, i, j):
+        """AppendEntries(i, j) — JointConsensus :556-582 / AddRemove
+        :546-572: member- and snapshot-sentinel-gated; empty requests are
+        send-once."""
+        p = self.p
+        L = p.max_log
+        d = self._dec(s)
+        ni_ij = d["nextIndex"][i, j]
+        valid = (
+            (d["state"][i] == LEADER)
+            & (((d["config_members"][i] >> j) & 1) > 0)
+            & (ni_ij >= 0)
+            & (((d["pendingResponse"][i] >> j) & 1) == 0)
+        )
+        prev_idx = ni_ij - 1
+        prev_term = jnp.where(
+            prev_idx > 0, d["log_term"][i][jnp.clip(prev_idx - 1, 0, L - 1)], 0
+        )
+        last_entry = jnp.minimum(d["log_len"][i], ni_ij)
+        nent = (last_entry >= ni_ij).astype(jnp.int32)
+        epos = jnp.clip(ni_ij - 1, 0, L - 1)
+        z = jnp.int32(0)
+        kw = dict(
+            mtype=AEREQ,
+            mterm=d["currentTerm"][i],
+            mprevLogIndex=jnp.clip(prev_idx, 0),
+            mprevLogTerm=prev_term,
+            nentries=nent,
+            mcommitIndex=jnp.clip(jnp.minimum(d["commitIndex"][i], last_entry), 0),
+            msource=i,
+            mdest=j,
+        )
+        for n in self.ENTRY_FIELDS:
+            kw[f"e_{n}"] = jnp.where(nent > 0, d[f"log_{n}"][i][epos], z)
+        key = self._pack(**kw)
+        words, cnt, existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
+        valid &= (nent > 0) | ~existed  # empty AEReq is send-once
+        succ = self._asm(
+            d,
+            pendingResponse=d["pendingResponse"].at[i].set(
+                d["pendingResponse"][i] | (jnp.int32(1) << j)
+            ),
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(R_APPENDENTRIES), ovf & valid
+
+    def _send_snapshot(self, s, i, j):
+        """SendSnapshot(i, j) — JointConsensus :885-901 / AddRemove
+        :862-878: embeds the whole log in the request."""
+        p, L = self.p, self.p.max_log
+        d = self._dec(s)
+        valid = (
+            (d["state"][i] == LEADER)
+            & (((d["config_members"][i] >> j) & 1) > 0)
+            & (d["nextIndex"][i, j] == PENDING_SNAP_REQUEST)
+        )
+        kw = dict(
+            mtype=SNAPREQ,
+            mterm=d["currentTerm"][i],
+            mcommitIndex=d["commitIndex"][i],
+            mmembers=d["config_members"][i],
+            mloglen=d["log_len"][i],
+            msource=i,
+            mdest=j,
+        )
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        live = lanes < d["log_len"][i]
+        for k in range(L):
+            for n in self.ENTRY_FIELDS:
+                kw[f"l{k}_{n}"] = jnp.where(live[k], d[f"log_{n}"][i][k], 0)
+        key = self._pack(**kw)
+        words, cnt, _existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
+        succ = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[i, j].set(PENDING_SNAP_RESPONSE),
+            **self._word_upd(words, cnt),
+        )
+        return valid, succ, jnp.int32(R_SENDSNAP), ovf & valid
+
+    # ---------------- shared invariants ----------------
+
+    def _inv_no_log_divergence(self, states):
+        """NoLogDivergence — JointConsensus :1066-1074 / AddRemove
+        :1017-1025 (full-entry equality over all entry lanes)."""
+        lay, L = self.layout, self.p.max_log
+        ci = lay.get(states, "commitIndex")
+        mci = jnp.minimum(ci[:, :, None], ci[:, None, :])
+        lanes = jnp.arange(1, L + 1, dtype=jnp.int32)
+        in_common = lanes[None, None, None, :] <= mci[..., None]
+        eq = jnp.ones(in_common.shape, dtype=bool)
+        for n in self.ENTRY_FIELDS:
+            f = lay.get(states, f"log_{n}")
+            eq &= f[:, :, None, :] == f[:, None, :, :]
+        return jnp.all(~in_common | eq, axis=(1, 2, 3))
+
+    def _inv_leader_has_acked(self, states):
+        """LeaderHasAllAckedValues — JointConsensus :1109-1125 / AddRemove
+        :1047-1063."""
+        lay, V = self.layout, self.p.n_values
+        ct = lay.get(states, "currentTerm")
+        st = lay.get(states, "state")
+        lv = lay.get(states, "log_val")
+        cmd = lay.get(states, "log_cmd")
+        acked = lay.get(states, "acked")
+        not_stale = jnp.all(ct[:, :, None] >= ct[:, None, :], axis=2)
+        is_lead = (st == LEADER) & not_stale
+        vals = jnp.arange(1, V + 1, dtype=jnp.int32)
+        lv_app = jnp.where(cmd == self.CMD_APPEND, lv, 0)
+        has_v = jnp.any(lv_app[:, :, None, :] == vals[None, None, :, None], axis=3)
+        bad = jnp.any(
+            (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v,
+            axis=(1, 2),
+        )
+        return ~bad

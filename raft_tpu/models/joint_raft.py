@@ -92,6 +92,21 @@ PENDING_SNAP_RESPONSE = -2  # :294
     J_HANDLE_SNAPRESP,
 ) = range(17)
 
+from .config_common import (
+    ConfigRaftCommon,
+    R_APPENDENTRIES as _R_AE,
+    R_CLIENTREQUEST as _R_CR,
+    R_REQUESTVOTE as _R_RV,
+    R_RESTART as _R_RS,
+    R_SENDSNAP as _R_SS,
+)
+
+# the mixin's kernels emit the shared rank constants; both variants lay
+# their Next out so these coincide (config_common.py docstring)
+assert (J_RESTART, J_REQUESTVOTE, J_CLIENTREQUEST,
+        J_APPENDENTRIES, J_SENDSNAP) == (
+    _R_RS, _R_RV, _R_CR, _R_AE, _R_SS)
+
 ACTION_NAMES = [
     "Restart",
     "UpdateTerm",
@@ -257,10 +272,13 @@ def cached_model(params: "JointRaftParams") -> "JointRaftModel":
     return _cached_model(params)
 
 
-class JointRaftModel:
+class JointRaftModel(ConfigRaftCommon):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "RaftWithReconfigJointConsensus"
+    ENTRY_FIELDS = ENTRY_FIELDS
+    CMD_APPEND = CMD_APPEND
+    ACTION_NAMES = ACTION_NAMES
 
     def __init__(self, params, server_names=None, value_names=None):
         self.p = params
@@ -319,48 +337,7 @@ class JointRaftModel:
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
 
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
-
     # ---------------- field access helpers ----------------
-
-    def _dec(self, s):
-        g = self.layout.get
-        return {f: g(s, f) for f in self.layout.fields}
-
-    def _asm(self, d, **updates):
-        parts = []
-        for name, f in self.layout.fields.items():
-            arr = updates.get(name, d[name])
-            arr = jnp.asarray(arr, jnp.int32)
-            parts.append(arr.reshape(-1) if f.shape else arr.reshape(1))
-        return jnp.concatenate(parts)
-
-    def _pack(self, **vals):
-        return tuple(jnp.asarray(w, jnp.int32) for w in self.packer.pack(**vals))
-
-    def _words(self, d):
-        return [d[f"msg_w{k}"] for k in range(self.n_words)]
-
-    def _bag_put(self, words, cnt, key):
-        return bag.wide_bag_put(words, cnt, key)
-
-    def _word_upd(self, words, cnt):
-        upd = {f"msg_w{k}": w for k, w in enumerate(words)}
-        upd["msg_cnt"] = cnt
-        return upd
-
-    @staticmethod
-    def _last_term(d, i):
-        ll = d["log_len"][i]
-        return jnp.where(ll > 0, d["log_term"][i][jnp.clip(ll - 1, 0)], 0)
-
-    @staticmethod
-    def _popcount(x, S):
-        return jnp.sum((x >> jnp.arange(S, dtype=jnp.int32)) & 1)
 
     def _mrce(self, d, i):
         """MostRecentReconfigEntry — :251-257. Returns (index, cmd, cid,
@@ -398,66 +375,6 @@ class JointRaftModel:
 
     # ---------------- action kernels ----------------
 
-    def _restart(self, s, i):
-        """Restart(i) — :362-374."""
-        p, S = self.p, self.p.n_servers
-        d = self._dec(s)
-        valid = d["restartCtr"] < p.max_restarts
-        succ = self._asm(
-            d,
-            state=d["state"].at[i].set(FOLLOWER),
-            votesGranted=d["votesGranted"].at[i].set(0),
-            nextIndex=d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
-            matchIndex=d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
-            pendingResponse=d["pendingResponse"].at[i].set(0),
-            commitIndex=d["commitIndex"].at[i].set(0),
-            restartCtr=d["restartCtr"] + 1,
-        )
-        return valid, succ, jnp.int32(J_RESTART), jnp.asarray(False)
-
-    def _request_vote(self, s, i):
-        """RequestVote(i) — :431-450."""
-        p, S = self.p, self.p.n_servers
-        d = self._dec(s)
-        st_i = d["state"][i]
-        members = d["config_members"][i]
-        valid = (
-            (d["electionCtr"] < p.max_elections)
-            & ((st_i == FOLLOWER) | (st_i == CANDIDATE))
-            & (((members >> i) & 1) > 0)
-        )
-        new_term = d["currentTerm"][i] + 1
-        last_t = self._last_term(d, i)
-        ll_i = d["log_len"][i]
-        words, cnt = self._words(d), d["msg_cnt"]
-        ovf = jnp.asarray(False)
-        for delta in range(1, S):
-            j = jnp.mod(i + delta, S)
-            is_member = ((members >> j) & 1) > 0
-            key = self._pack(
-                mtype=RVREQ,
-                mterm=new_term,
-                mlastLogTerm=last_t,
-                mlastLogIndex=ll_i,
-                msource=i,
-                mdest=j,
-            )
-            w2, c2, existed, o = self._bag_put(words, cnt, key)
-            valid &= (~is_member) | ~existed
-            ovf |= is_member & o
-            words = [jnp.where(is_member, a, b) for a, b in zip(w2, words)]
-            cnt = jnp.where(is_member, c2, cnt)
-        succ = self._asm(
-            d,
-            state=d["state"].at[i].set(CANDIDATE),
-            currentTerm=d["currentTerm"].at[i].set(new_term),
-            votedFor=d["votedFor"].at[i].set(i + 1),
-            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
-            electionCtr=d["electionCtr"] + 1,
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(J_REQUESTVOTE), ovf & valid
-
     def _become_leader(self, s, i):
         """BecomeLeader(i) — :511-528: dual quorums while joint."""
         S = self.p.n_servers
@@ -485,31 +402,6 @@ class JointRaftModel:
             pendingResponse=d["pendingResponse"].at[i].set(0),
         )
         return valid, succ, jnp.int32(J_BECOMELEADER), jnp.asarray(False)
-
-    def _client_request(self, s, i, v):
-        """ClientRequest(i, v) — :535-550."""
-        p, L = self.p, self.p.max_log
-        d = self._dec(s)
-        term = d["currentTerm"][i]
-        tpos = jnp.clip(term - 1, 0, p.max_term - 1)
-        valid = (
-            (d["state"][i] == LEADER)
-            & (d["acked"][v] == ACK_NIL)
-            & (d["valueCtr"][tpos] < p.max_values_per_term)
-        )
-        pos = d["log_len"][i]
-        ovf = valid & (pos >= L)
-        posc = jnp.clip(pos, 0, L - 1)
-        succ = self._asm(
-            d,
-            log_term=d["log_term"].at[i, posc].set(term),
-            log_cmd=d["log_cmd"].at[i, posc].set(CMD_APPEND),
-            log_val=d["log_val"].at[i, posc].set(v + 1),
-            log_len=d["log_len"].at[i].add(1),
-            acked=d["acked"].at[v].set(ACK_FALSE),
-            valueCtr=d["valueCtr"].at[tpos].add(1),
-        )
-        return valid, succ, jnp.int32(J_CLIENTREQUEST), ovf
 
     def _advance_commit_index(self, s, i):
         """AdvanceCommitIndex(i) — :613-653: dual-quorum agreement while
@@ -582,50 +474,6 @@ class JointRaftModel:
         )
         succ = self._asm(d, **upd)
         return valid, succ, jnp.int32(J_ADVANCECOMMIT), jnp.asarray(False)
-
-    def _append_entries(self, s, i, j):
-        """AppendEntries(i, j) — :556-582."""
-        p = self.p
-        L = p.max_log
-        d = self._dec(s)
-        ni_ij = d["nextIndex"][i, j]
-        valid = (
-            (d["state"][i] == LEADER)
-            & (((d["config_members"][i] >> j) & 1) > 0)
-            & (ni_ij >= 0)
-            & (((d["pendingResponse"][i] >> j) & 1) == 0)
-        )
-        prev_idx = ni_ij - 1
-        prev_term = jnp.where(
-            prev_idx > 0, d["log_term"][i][jnp.clip(prev_idx - 1, 0, L - 1)], 0
-        )
-        last_entry = jnp.minimum(d["log_len"][i], ni_ij)
-        nent = (last_entry >= ni_ij).astype(jnp.int32)
-        epos = jnp.clip(ni_ij - 1, 0, L - 1)
-        z = jnp.int32(0)
-        kw = dict(
-            mtype=AEREQ,
-            mterm=d["currentTerm"][i],
-            mprevLogIndex=jnp.clip(prev_idx, 0),
-            mprevLogTerm=prev_term,
-            nentries=nent,
-            mcommitIndex=jnp.clip(jnp.minimum(d["commitIndex"][i], last_entry), 0),
-            msource=i,
-            mdest=j,
-        )
-        for n in ENTRY_FIELDS:
-            kw[f"e_{n}"] = jnp.where(nent > 0, d[f"log_{n}"][i][epos], z)
-        key = self._pack(**kw)
-        words, cnt, existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
-        valid &= (nent > 0) | ~existed  # empty AEReq is send-once (:177-181)
-        succ = self._asm(
-            d,
-            pendingResponse=d["pendingResponse"].at[i].set(
-                d["pendingResponse"][i] | (jnp.int32(1) << j)
-            ),
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(J_APPENDENTRIES), ovf & valid
 
     def _append_old_new(self, s, i, add_mask, rem_mask):
         """AppendOldNewConfigToLog(i) for one admitted (add, remove) subset
@@ -722,38 +570,6 @@ class JointRaftModel:
             ),
         )
         return valid, succ, jnp.int32(J_APPEND_NEW), ovf
-
-    def _send_snapshot(self, s, i, j):
-        """SendSnapshot(i, j) — :885-901."""
-        p, L = self.p, self.p.max_log
-        d = self._dec(s)
-        valid = (
-            (d["state"][i] == LEADER)
-            & (((d["config_members"][i] >> j) & 1) > 0)
-            & (d["nextIndex"][i, j] == PENDING_SNAP_REQUEST)
-        )
-        kw = dict(
-            mtype=SNAPREQ,
-            mterm=d["currentTerm"][i],
-            mcommitIndex=d["commitIndex"][i],
-            mmembers=d["config_members"][i],
-            mloglen=d["log_len"][i],
-            msource=i,
-            mdest=j,
-        )
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        live = lanes < d["log_len"][i]
-        for k in range(L):
-            for n in ENTRY_FIELDS:
-                kw[f"l{k}_{n}"] = jnp.where(live[k], d[f"log_{n}"][i][k], 0)
-        key = self._pack(**kw)
-        words, cnt, _existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
-        succ = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[i, j].set(PENDING_SNAP_RESPONSE),
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(J_SENDSNAP), ovf & valid
 
     # -------- fused message-receipt kernel (slot m) --------
 
@@ -1132,19 +948,6 @@ class JointRaftModel:
 
     # ---------------- invariants ----------------
 
-    def _inv_no_log_divergence(self, states):
-        """NoLogDivergence — :1066-1074."""
-        lay, L = self.layout, self.p.max_log
-        ci = lay.get(states, "commitIndex")
-        mci = jnp.minimum(ci[:, :, None], ci[:, None, :])
-        lanes = jnp.arange(1, L + 1, dtype=jnp.int32)
-        in_common = lanes[None, None, None, :] <= mci[..., None]
-        eq = jnp.ones(in_common.shape, dtype=bool)
-        for n in ENTRY_FIELDS:
-            f = lay.get(states, f"log_{n}")
-            eq &= f[:, :, None, :] == f[:, None, :, :]
-        return jnp.all(~in_common | eq, axis=(1, 2, 3))
-
     def _inv_max_one_reconfig(self, states):
         """MaxOneReconfigurationAtATime — :1080-1101: same-type config
         commands need the opposite type strictly between them."""
@@ -1172,25 +975,6 @@ class JointRaftModel:
             bad = pair & upper[None, None] & ~has_between
             ok &= ~jnp.any(bad, axis=(2, 3))
         return jnp.all(ok, axis=1)
-
-    def _inv_leader_has_acked(self, states):
-        """LeaderHasAllAckedValues — :1109-1125."""
-        lay, V = self.layout, self.p.n_values
-        ct = lay.get(states, "currentTerm")
-        st = lay.get(states, "state")
-        lv = lay.get(states, "log_val")
-        cmd = lay.get(states, "log_cmd")
-        acked = lay.get(states, "acked")
-        not_stale = jnp.all(ct[:, :, None] >= ct[:, None, :], axis=2)
-        is_lead = (st == LEADER) & not_stale
-        vals = jnp.arange(1, V + 1, dtype=jnp.int32)
-        lv_app = jnp.where(cmd == CMD_APPEND, lv, 0)
-        has_v = jnp.any(lv_app[:, :, None, :] == vals[None, None, :, None], axis=3)
-        bad = jnp.any(
-            (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v,
-            axis=(1, 2),
-        )
-        return ~bad
 
     def _inv_committed_majority(self, states):
         """CommittedEntriesReachMajority — :1129-1140."""

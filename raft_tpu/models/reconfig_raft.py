@@ -98,6 +98,21 @@ PENDING_SNAP_RESPONSE = -2  # :272
     A_RESET_IDENTITY,
 ) = range(18)
 
+from .config_common import (
+    ConfigRaftCommon,
+    R_APPENDENTRIES as _R_AE,
+    R_CLIENTREQUEST as _R_CR,
+    R_REQUESTVOTE as _R_RV,
+    R_RESTART as _R_RS,
+    R_SENDSNAP as _R_SS,
+)
+
+# the mixin's kernels emit the shared rank constants; both variants lay
+# their Next out so these coincide (config_common.py docstring)
+assert (A_RESTART, A_REQUESTVOTE, A_CLIENTREQUEST,
+        A_APPENDENTRIES, A_SENDSNAP) == (
+    _R_RS, _R_RV, _R_CR, _R_AE, _R_SS)
+
 ACTION_NAMES = [
     "Restart",
     "UpdateTerm",
@@ -234,10 +249,13 @@ def cached_model(params: "ReconfigRaftParams") -> "ReconfigRaftModel":
     return _cached_model(params)
 
 
-class ReconfigRaftModel:
+class ReconfigRaftModel(ConfigRaftCommon):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "RaftWithReconfigAddRemove"
+    ENTRY_FIELDS = ("term", "cmd", "val", "cid", "cmem", "cmembers")
+    CMD_APPEND = CMD_APPEND
+    ACTION_NAMES = ACTION_NAMES
 
     def __init__(self, params, server_names=None, value_names=None):
         self.p = params
@@ -299,49 +317,7 @@ class ReconfigRaftModel:
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
 
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
-
     # ---------------- field access helpers ----------------
-
-    def _dec(self, s):
-        g = self.layout.get
-        return {f: g(s, f) for f in self.layout.fields}
-
-    def _asm(self, d, **updates):
-        parts = []
-        for name, f in self.layout.fields.items():
-            arr = updates.get(name, d[name])
-            arr = jnp.asarray(arr, jnp.int32)
-            parts.append(arr.reshape(-1) if f.shape else arr.reshape(1))
-        return jnp.concatenate(parts)
-
-    def _pack(self, **vals):
-        return tuple(jnp.asarray(w, jnp.int32) for w in self.packer.pack(**vals))
-
-    def _words(self, d):
-        return [d[f"msg_w{k}"] for k in range(self.n_words)]
-
-    def _bag_put(self, words, cnt, key):
-        return bag.wide_bag_put(words, cnt, key)
-
-    def _word_upd(self, words, cnt):
-        upd = {f"msg_w{k}": w for k, w in enumerate(words)}
-        upd["msg_cnt"] = cnt
-        return upd
-
-    @staticmethod
-    def _last_term(d, i):
-        """LastTerm — :173."""
-        ll = d["log_len"][i]
-        return jnp.where(ll > 0, d["log_term"][i][jnp.clip(ll - 1, 0)], 0)
-
-    @staticmethod
-    def _popcount(x, S):
-        return jnp.sum((x >> jnp.arange(S, dtype=jnp.int32)) & 1)
 
     def _mrce(self, d, i):
         """MostRecentReconfigEntry over log[i] — :252-258. Returns
@@ -357,66 +333,6 @@ class ReconfigRaftModel:
         return idx, d["log_cid"][i][pos], d["log_cmembers"][i][pos]
 
     # ---------------- action kernels ----------------
-
-    def _restart(self, s, i):
-        """Restart(i) — :346-358: keeps config, currentTerm, votedFor, log."""
-        p, S = self.p, self.p.n_servers
-        d = self._dec(s)
-        valid = d["restartCtr"] < p.max_restarts
-        succ = self._asm(
-            d,
-            state=d["state"].at[i].set(FOLLOWER),
-            votesGranted=d["votesGranted"].at[i].set(0),
-            nextIndex=d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
-            matchIndex=d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
-            pendingResponse=d["pendingResponse"].at[i].set(0),
-            commitIndex=d["commitIndex"].at[i].set(0),
-            restartCtr=d["restartCtr"] + 1,
-        )
-        return valid, succ, jnp.int32(A_RESTART), jnp.asarray(False)
-
-    def _request_vote(self, s, i):
-        """RequestVote(i) — :425-444: member-only; notifies the member set."""
-        p, S = self.p, self.p.n_servers
-        d = self._dec(s)
-        st_i = d["state"][i]
-        members = d["config_members"][i]
-        valid = (
-            (d["electionCtr"] < p.max_elections)
-            & ((st_i == FOLLOWER) | (st_i == CANDIDATE))
-            & (((members >> i) & 1) > 0)
-        )
-        new_term = d["currentTerm"][i] + 1
-        last_t = self._last_term(d, i)
-        ll_i = d["log_len"][i]
-        words, cnt = self._words(d), d["msg_cnt"]
-        ovf = jnp.asarray(False)
-        for delta in range(1, S):
-            j = jnp.mod(i + delta, S)
-            is_member = ((members >> j) & 1) > 0
-            key = self._pack(
-                mtype=RVREQ,
-                mterm=new_term,
-                mlastLogTerm=last_t,
-                mlastLogIndex=ll_i,
-                msource=i,
-                mdest=j,
-            )
-            w2, c2, existed, o = self._bag_put(words, cnt, key)
-            valid &= (~is_member) | ~existed  # SendMultipleOnce (:200-202)
-            ovf |= is_member & o
-            words = [jnp.where(is_member, a, b) for a, b in zip(w2, words)]
-            cnt = jnp.where(is_member, c2, cnt)
-        succ = self._asm(
-            d,
-            state=d["state"].at[i].set(CANDIDATE),
-            currentTerm=d["currentTerm"].at[i].set(new_term),
-            votedFor=d["votedFor"].at[i].set(i + 1),
-            votesGranted=d["votesGranted"].at[i].set(jnp.int32(1) << i),
-            electionCtr=d["electionCtr"] + 1,
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(A_REQUESTVOTE), ovf & valid
 
     def _become_leader(self, s, i):
         """BecomeLeader(i) — :505-518: votesGranted must be a quorum OF the
@@ -438,31 +354,6 @@ class ReconfigRaftModel:
             pendingResponse=d["pendingResponse"].at[i].set(0),
         )
         return valid, succ, jnp.int32(A_BECOMELEADER), jnp.asarray(False)
-
-    def _client_request(self, s, i, v):
-        """ClientRequest(i, v) — :525-540 (acked gate + per-term valueCtr)."""
-        p, L = self.p, self.p.max_log
-        d = self._dec(s)
-        term = d["currentTerm"][i]
-        tpos = jnp.clip(term - 1, 0, p.max_term - 1)
-        valid = (
-            (d["state"][i] == LEADER)
-            & (d["acked"][v] == ACK_NIL)
-            & (d["valueCtr"][tpos] < p.max_values_per_term)
-        )
-        pos = d["log_len"][i]
-        ovf = valid & (pos >= L)
-        posc = jnp.clip(pos, 0, L - 1)
-        succ = self._asm(
-            d,
-            log_term=d["log_term"].at[i, posc].set(term),
-            log_cmd=d["log_cmd"].at[i, posc].set(CMD_APPEND),
-            log_val=d["log_val"].at[i, posc].set(v + 1),
-            log_len=d["log_len"].at[i].add(1),
-            acked=d["acked"].at[v].set(ACK_FALSE),
-            valueCtr=d["valueCtr"].at[tpos].add(1),
-        )
-        return valid, succ, jnp.int32(A_CLIENTREQUEST), ovf
 
     def _advance_commit_index(self, s, i):
         """AdvanceCommitIndex(i) — :605-642: member-set quorum with leader
@@ -537,54 +428,6 @@ class ReconfigRaftModel:
         )
         succ = self._asm(d, **upd)
         return valid, succ, jnp.int32(A_ADVANCECOMMIT), jnp.asarray(False)
-
-    def _append_entries(self, s, i, j):
-        """AppendEntries(i, j) — :546-572: member- and sentinel-gated."""
-        p = self.p
-        L = p.max_log
-        d = self._dec(s)
-        ni_ij = d["nextIndex"][i, j]
-        valid = (
-            (d["state"][i] == LEADER)
-            & (((d["config_members"][i] >> j) & 1) > 0)
-            & (ni_ij >= 0)
-            & (((d["pendingResponse"][i] >> j) & 1) == 0)
-        )
-        prev_idx = ni_ij - 1
-        prev_term = jnp.where(
-            prev_idx > 0, d["log_term"][i][jnp.clip(prev_idx - 1, 0, L - 1)], 0
-        )
-        last_entry = jnp.minimum(d["log_len"][i], ni_ij)
-        nent = (last_entry >= ni_ij).astype(jnp.int32)
-        epos = jnp.clip(ni_ij - 1, 0, L - 1)
-        z = jnp.int32(0)
-        key = self._pack(
-            mtype=AEREQ,
-            mterm=d["currentTerm"][i],
-            mprevLogIndex=jnp.clip(prev_idx, 0),
-            mprevLogTerm=prev_term,
-            nentries=nent,
-            e_term=jnp.where(nent > 0, d["log_term"][i][epos], z),
-            e_cmd=jnp.where(nent > 0, d["log_cmd"][i][epos], z),
-            e_val=jnp.where(nent > 0, d["log_val"][i][epos], z),
-            e_cid=jnp.where(nent > 0, d["log_cid"][i][epos], z),
-            e_cmem=jnp.where(nent > 0, d["log_cmem"][i][epos], z),
-            e_cmembers=jnp.where(nent > 0, d["log_cmembers"][i][epos], z),
-            mcommitIndex=jnp.clip(jnp.minimum(d["commitIndex"][i], last_entry), 0),
-            msource=i,
-            mdest=j,
-        )
-        words, cnt, existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
-        # Send (:192-196): empty AppendEntriesRequest is send-once
-        valid &= (nent > 0) | ~existed
-        succ = self._asm(
-            d,
-            pendingResponse=d["pendingResponse"].at[i].set(
-                d["pendingResponse"][i] | (jnp.int32(1) << j)
-            ),
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(A_APPENDENTRIES), ovf & valid
 
     def _append_add(self, s, i, a):
         """AppendAddServerCommandToLog(i, a) — :795-824."""
@@ -672,38 +515,6 @@ class ReconfigRaftModel:
             removeReconfigCtr=d["removeReconfigCtr"] + 1,
         )
         return valid, succ, jnp.int32(A_APPEND_REMOVE), ovf
-
-    def _send_snapshot(self, s, i, j):
-        """SendSnapshot(i, j) — :862-878: embeds the whole log."""
-        p, L = self.p, self.p.max_log
-        d = self._dec(s)
-        valid = (
-            (d["state"][i] == LEADER)
-            & (((d["config_members"][i] >> j) & 1) > 0)
-            & (d["nextIndex"][i, j] == PENDING_SNAP_REQUEST)
-        )
-        kw = dict(
-            mtype=SNAPREQ,
-            mterm=d["currentTerm"][i],
-            mcommitIndex=d["commitIndex"][i],
-            mmembers=d["config_members"][i],
-            mloglen=d["log_len"][i],
-            msource=i,
-            mdest=j,
-        )
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        live = lanes < d["log_len"][i]
-        for k in range(L):
-            for n in ("term", "cmd", "val", "cid", "cmem", "cmembers"):
-                kw[f"l{k}_{n}"] = jnp.where(live[k], d[f"log_{n}"][i][k], 0)
-        key = self._pack(**kw)
-        words, cnt, _existed, ovf = self._bag_put(self._words(d), d["msg_cnt"], key)
-        succ = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[i, j].set(PENDING_SNAP_RESPONSE),
-            **self._word_upd(words, cnt),
-        )
-        return valid, succ, jnp.int32(A_SENDSNAP), ovf & valid
 
     def _reset_with_same_identity(self, s, i):
         """ResetWithSameIdentity(i) — :385-400; CHOOSE-a-leader lowered as
@@ -1120,19 +931,6 @@ class ReconfigRaftModel:
 
     # ---------------- invariants ----------------
 
-    def _inv_no_log_divergence(self, states):
-        """NoLogDivergence — :1017-1025 (full-entry equality)."""
-        lay, L = self.layout, self.p.max_log
-        ci = lay.get(states, "commitIndex")
-        mci = jnp.minimum(ci[:, :, None], ci[:, None, :])
-        lanes = jnp.arange(1, L + 1, dtype=jnp.int32)
-        in_common = lanes[None, None, None, :] <= mci[..., None]
-        eq = jnp.ones(in_common.shape, dtype=bool)
-        for n in ("term", "cmd", "val", "cid", "cmem", "cmembers"):
-            f = lay.get(states, f"log_{n}")
-            eq &= f[:, :, None, :] == f[:, None, :, :]
-        return jnp.all(~in_common | eq, axis=(1, 2, 3))
-
     def _inv_max_one_reconfig(self, states):
         """MaxOneReconfigurationAtATime — :1031-1039."""
         lay, L = self.layout, self.p.max_log
@@ -1150,25 +948,6 @@ class ReconfigRaftModel:
         n_uncommitted = jnp.sum(uncommitted, axis=2)
         bad = (st == LEADER) & (n_uncommitted >= 2)
         return ~jnp.any(bad, axis=1)
-
-    def _inv_leader_has_acked(self, states):
-        """LeaderHasAllAckedValues — :1047-1063."""
-        lay, V = self.layout, self.p.n_values
-        ct = lay.get(states, "currentTerm")
-        st = lay.get(states, "state")
-        lv = lay.get(states, "log_val")
-        cmd = lay.get(states, "log_cmd")
-        acked = lay.get(states, "acked")
-        not_stale = jnp.all(ct[:, :, None] >= ct[:, None, :], axis=2)
-        is_lead = (st == LEADER) & not_stale
-        vals = jnp.arange(1, V + 1, dtype=jnp.int32)
-        lv_app = jnp.where(cmd == CMD_APPEND, lv, 0)
-        has_v = jnp.any(lv_app[:, :, None, :] == vals[None, None, :, None], axis=3)
-        bad = jnp.any(
-            (acked[:, None, :] == ACK_TRUE) & is_lead[:, :, None] & ~has_v,
-            axis=(1, 2),
-        )
-        return ~bad
 
     def _inv_committed_majority(self, states):
         """CommittedEntriesReachMajority — :1067-1078 (quorum drawn from

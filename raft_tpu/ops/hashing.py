@@ -29,11 +29,22 @@ def mix64(z):
 
 
 def hash_lanes(vec, seed: int = 0):
-    """Hash an int32 [..., K] vector to uint64 [...]."""
+    """Hash an int32 [..., K] vector to uint64 [...].
+
+    A nonzero seed selects an independent hash family by XORing a
+    seed-derived per-lane stream into the inputs BEFORE the multiply —
+    a constant additive seed would merely translate every lane's pre-mix
+    input, leaving the family invariant on the collision class where two
+    states' multisets of pre-mix lane values coincide (the collision
+    audit, checker/audit.py, relies on families failing independently).
+    seed=0 is the identity stream, keeping default fingerprints stable
+    across this change (checkpoints store them)."""
     k = vec.shape[-1]
     x = vec.astype(jnp.uint64)
     pos = jnp.arange(k, dtype=jnp.uint64)
-    h = mix64((x + np.uint64(seed)) * _C1 + pos * _C2)
+    if seed:
+        x = x ^ mix64(pos * _C2 + np.uint64(seed))
+    h = mix64(x * _C1 + pos * _C2)
     acc = jnp.bitwise_xor.reduce(h, axis=-1)
     kmix = np.uint64((k * int(_C1)) & 0xFFFFFFFFFFFFFFFF)
     return mix64(acc ^ kmix)
