@@ -158,6 +158,21 @@ def main(argv=None):
         )
         return 64
 
+    # device-checker capacity flags, shared by the collision audit and the
+    # main run so both execute at the same geometry
+    cli_caps = {
+        k: v
+        for k, v in {
+            "frontier_cap": args.frontier_cap,
+            "seen_cap": args.seen_cap,
+            "journal_cap": args.journal_cap,
+            "max_frontier_cap": args.max_frontier_cap,
+            "max_seen_cap": args.max_seen_cap,
+            "max_journal_cap": args.max_journal_cap,
+        }.items()
+        if v is not None
+    }
+
     if args.collision_audit is not None:
         if args.checker != "tpu" or args.simulate is not None:
             print(
@@ -168,21 +183,9 @@ def main(argv=None):
             return 64
         from .checker.audit import collision_audit
 
-        audit_caps = {
-            k: v
-            for k, v in {
-                "frontier_cap": args.frontier_cap,
-                "seen_cap": args.seen_cap,
-                "journal_cap": args.journal_cap,
-                "max_frontier_cap": args.max_frontier_cap,
-                "max_seen_cap": args.max_seen_cap,
-                "max_journal_cap": args.max_journal_cap,
-            }.items()
-            if v is not None
-        }
         audit = collision_audit(
             setup.model, invariants=setup.invariants, symmetry=symmetry,
-            depth=args.collision_audit, chunk=args.chunk, **audit_caps,
+            depth=args.collision_audit, chunk=args.chunk, **cli_caps,
         )
         print(audit)
         if not audit.ok:
@@ -269,24 +272,12 @@ def main(argv=None):
     if args.checker == "tpu":
         from .checker.device_bfs import DeviceBFS
 
-        caps = {
-            k: v
-            for k, v in {
-                "frontier_cap": args.frontier_cap,
-                "seen_cap": args.seen_cap,
-                "journal_cap": args.journal_cap,
-                "max_frontier_cap": args.max_frontier_cap,
-                "max_seen_cap": args.max_seen_cap,
-                "max_journal_cap": args.max_journal_cap,
-            }.items()
-            if v is not None
-        }
         checker = DeviceBFS(
             setup.model,
             invariants=setup.invariants,
             symmetry=symmetry,
             chunk=args.chunk,
-            **caps,
+            **cli_caps,
         )
     else:
         from .checker.bfs import BFSChecker

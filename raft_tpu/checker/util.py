@@ -18,26 +18,6 @@ def probe_sorted(sorted_arr, vals):
     return sorted_arr[pos] == vals
 
 
-def merge_sorted(a, b):
-    """Merge two sorted u64 arrays (U64_MAX padding sorts last) into one
-    sorted array of length len(a)+len(b), in O(n log n) binary searches +
-    two scatters instead of a full O(n log n)-comparison re-sort of the
-    concatenation — the distinction matters because XLA sorts are
-    expensive at seen-set scale while searchsorted vectorizes flat.
-
-    Placement: a[i] lands at i + |{b < a[i]}| (side='left'), b[j] at
-    j + |{a <= b[j]}| (side='right'); ties order a-first, and both maps
-    are collision-free (within-array offsets are strictly increasing,
-    and for a[i] == b[j] the b element counts the equal a's)."""
-    la, lb = a.shape[0], b.shape[0]
-    ia = jnp.searchsorted(b, a, side="left")
-    ib = jnp.searchsorted(a, b, side="right")
-    out = jnp.zeros((la + lb,), a.dtype)
-    out = out.at[jnp.arange(la) + ia].set(a)
-    out = out.at[jnp.arange(lb) + ib].set(b)
-    return out
-
-
 def next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:
     """Smallest growth**k * cap >= needed, rounded up to a multiple of
     unit, never exceeding max_cap (max_cap is rounded DOWN to a unit

@@ -39,9 +39,11 @@ from ..ops import bag
 from ..ops.packing import EMPTY, WidePacker, bits_for
 from .base import Layout, messages_are_valid_kernel
 
-FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
-NIL = 0
-ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
+from .config_common import (  # shared enums: single source of truth
+    ACK_FALSE, ACK_NIL, ACK_TRUE, CANDIDATE, FOLLOWER, LEADER, NIL,
+    NOTMEMBER, PENDING_SNAP_REQUEST, PENDING_SNAP_RESPONSE,
+    AEREQ, AERESP, RVREQ, RVRESP, SNAPREQ, SNAPRESP,
+)
 
 # log-entry commands (:58-60); 0 = empty lane
 CMD_NONE, CMD_APPEND, CMD_OLDNEW, CMD_NEW = range(4)
@@ -51,7 +53,6 @@ CMD_NAMES = {
     CMD_NEW: "NewConfigCommand",
 }
 
-RVREQ, RVRESP, AEREQ, AERESP, SNAPREQ, SNAPRESP = 1, 2, 3, 4, 5, 6
 MTYPE_NAMES = {
     RVREQ: "RequestVoteRequest",
     RVRESP: "RequestVoteResponse",
@@ -68,8 +69,6 @@ RC_NAMES = {
     RC_NEEDSNAP: "NeedSnapshot",
 }
 
-PENDING_SNAP_REQUEST = -1  # :293
-PENDING_SNAP_RESPONSE = -2  # :294
 
 # Next-disjunct ranks (:966-988), for trace labels.
 (

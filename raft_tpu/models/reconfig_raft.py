@@ -41,9 +41,11 @@ from ..ops import bag
 from ..ops.packing import EMPTY, WidePacker, bits_for
 from .base import Layout, messages_are_valid_kernel
 
-FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
-NIL = 0
-ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
+from .config_common import (  # shared enums: single source of truth
+    ACK_FALSE, ACK_NIL, ACK_TRUE, CANDIDATE, FOLLOWER, LEADER, NIL,
+    NOTMEMBER, PENDING_SNAP_REQUEST, PENDING_SNAP_RESPONSE,
+    AEREQ, AERESP, RVREQ, RVRESP, SNAPREQ, SNAPRESP,
+)
 
 # log-entry commands (RaftWithReconfigAddRemove.tla:66-69); 0 = empty lane
 CMD_NONE, CMD_INIT, CMD_APPEND, CMD_ADD, CMD_REMOVE = range(5)
@@ -55,7 +57,6 @@ CMD_NAMES = {
 }
 
 # mtype (:78-80)
-RVREQ, RVRESP, AEREQ, AERESP, SNAPREQ, SNAPRESP = 1, 2, 3, 4, 5, 6
 MTYPE_NAMES = {
     RVREQ: "RequestVoteRequest",
     RVRESP: "RequestVoteResponse",
@@ -73,10 +74,10 @@ RC_NAMES = {
     RC_NEEDSNAP: "NeedSnapshot",
 }
 
-PENDING_SNAP_REQUEST = -1  # :271
-PENDING_SNAP_RESPONSE = -2  # :272
 
 # Next-disjunct ranks (:943-965), for trace labels.
+ENTRY_FIELDS = ("term", "cmd", "val", "cid", "cmem", "cmembers")
+
 (
     A_RESTART,
     A_UPDATETERM,
@@ -253,7 +254,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "RaftWithReconfigAddRemove"
-    ENTRY_FIELDS = ("term", "cmd", "val", "cid", "cmem", "cmembers")
+    ENTRY_FIELDS = ENTRY_FIELDS
     CMD_APPEND = CMD_APPEND
     ACTION_NAMES = ACTION_NAMES
 
@@ -685,7 +686,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
         keep = lanes < prev_idx
         app_pos = jnp.clip(prev_idx, 0, L - 1)
         new_logs = {}
-        for n in ("term", "cmd", "val", "cid", "cmem", "cmembers"):
+        for n in ENTRY_FIELDS:
             row = d[f"log_{n}"][dst]
             nrow = jnp.where(keep, row, 0).at[app_pos].set(
                 jnp.where(appending, u(f"e_{n}"), 0)
@@ -767,7 +768,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
         b_snapreq = recv & (mtype == SNAPREQ) & eq_term & (st_dst == FOLLOWER)
         sn_ll = u("mloglen")
         sn_logs = {}
-        for n in ("term", "cmd", "val", "cid", "cmem", "cmembers"):
+        for n in ENTRY_FIELDS:
             sn_logs[n] = jnp.stack([u(f"l{k}_{n}") for k in range(L)])
         sn_is_cfg = (
             (sn_logs["cmd"] == CMD_INIT)
@@ -960,7 +961,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
         lead = (st == LEADER) & (ci > 0)
         pos = jnp.clip(ci - 1, 0, L - 1)
         match = jnp.ones(st.shape[:1] + (S, S), dtype=bool)  # [B, i, j]
-        for n in ("term", "cmd", "val", "cid", "cmem", "cmembers"):
+        for n in ENTRY_FIELDS:
             f = lay.get(states, f"log_{n}")  # [B,S,L]
             fi = jnp.take_along_axis(f, pos[:, :, None], axis=2)[:, :, 0]  # [B,S]
             fj = jnp.take_along_axis(
@@ -1015,7 +1016,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
         S, L = p.n_servers, p.max_log
         rows = {
             n: g(f"log_{n}").reshape(S, L)
-            for n in ("term", "cmd", "val", "cid", "cmem", "cmembers")
+            for n in ENTRY_FIELDS
         }
         ll = g("log_len")
         log = tuple(
@@ -1186,7 +1187,7 @@ class ReconfigRaftModel(ConfigRaftCommon):
         ]
         rows = {
             n: np.zeros((S, L), np.int32)
-            for n in ("term", "cmd", "val", "cid", "cmem", "cmembers")
+            for n in ENTRY_FIELDS
         }
         for i, lg in enumerate(st["log"]):
             for k, e in enumerate(lg):

@@ -43,8 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..checker.util import (
-    GROWTH, HEADROOM, I32_MAX, merge_sorted,
-    next_cap as _next_cap, probe_sorted as _probe,
+    GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
 )
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
@@ -246,8 +245,9 @@ class ShardedBFS:
         jps = jps.at[jdst].set((sidx // RC).astype(jnp.int32))
         jpl = jpl.at[jdst].set(recv_pay[sidx, W])
         jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
-        new_sorted = jnp.sort(jnp.where(new, rf, U64_MAX))
-        wave_fps = merge_sorted(wave_fps, new_sorted)[: F + 1]
+        wave_fps = jnp.sort(
+            jnp.concatenate([wave_fps, jnp.where(new, rf, U64_MAX)])
+        )[: F + 1]
 
         # 8. invariants on the received candidates; fold first-bad jidx
         jidx = jnp.where(new, jcount + npos, I32_MAX)
@@ -282,7 +282,7 @@ class ShardedBFS:
         """End of wave: union wave fingerprints into the seen-set, reset
         the wave buffer and the per-wave counter."""
         seen, wave_fps, stats = seen[0], wave_fps[0], stats[0]
-        merged = merge_sorted(seen, wave_fps)[: self.SCAP]
+        merged = jnp.sort(jnp.concatenate([seen, wave_fps]))[: self.SCAP]
         fresh = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
         stats = stats.at[0].set(0)
         return merged[None], fresh[None], stats[None]

@@ -47,6 +47,7 @@ def _models():
     )
 
 
+@pytest.mark.slow
 def test_messages_are_valid_on_reachable_states():
     """Every device model exposes MessagesAreValid; it must hold on all
     reachable states of a small bounded run (the spec never self-sends,
@@ -75,6 +76,7 @@ def test_messages_are_valid_catches_corrupt_key():
     assert ok2.all()
 
 
+@pytest.mark.slow
 def test_collision_audit_passes_and_seeds_differ():
     model = cached_model(SMALL)
     res = collision_audit(

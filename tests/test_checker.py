@@ -21,6 +21,7 @@ def _bfs_pair(params, invariants, symmetry=True, max_depth=None, chunk=256):
     return res, ores, checker
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("symmetry", [True, False])
 def test_bfs_counts_match_oracle_small(symmetry):
     params = RaftParams(n_servers=3, n_values=1, max_elections=1, max_restarts=0, msg_slots=16)

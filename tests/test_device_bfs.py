@@ -27,6 +27,7 @@ def _device(params, invariants, symmetry=True, chunk=512, **kw):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("symmetry", [True, False])
 def test_device_bfs_matches_host_checker(symmetry):
     model = cached_model(SMALL)
@@ -41,6 +42,7 @@ def test_device_bfs_matches_host_checker(symmetry):
     assert dres.exhausted
 
 
+@pytest.mark.slow
 def test_device_bfs_chunk_sweep():
     """Identical counts at several chunk sizes — the invariance that the
     round-2 TPU dedup miscount silently broke."""
@@ -83,6 +85,7 @@ def test_device_bfs_trace_on_injected_invariant():
     assert res.violation.depth == hres.violation.depth
 
 
+@pytest.mark.slow
 def test_device_bfs_max_depth_and_time_budget():
     res = _device(SMALL, INVS).run(max_depth=5)
     assert not res.exhausted
@@ -97,6 +100,7 @@ def test_device_bfs_rejects_indivisible_chunk():
         _device(SMALL, INVS, chunk=768, frontier_cap=1 << 13)
 
 
+@pytest.mark.slow
 def test_device_bfs_capacity_growth():
     """Tiny initial caps; the run must grow all three buffers between
     waves and still produce exact counts (no states dropped)."""
@@ -120,6 +124,7 @@ def test_device_bfs_capacity_growth():
     assert res.terminal == ref.terminal
 
 
+@pytest.mark.slow
 def test_device_bfs_checkpoint_resume(tmp_path):
     """Split a run at a depth cap via checkpoint, resume in a fresh
     checker, and require the stitched result to equal a straight run —
@@ -151,6 +156,7 @@ def test_device_bfs_checkpoint_resume(tmp_path):
     assert [a for a, _ in r2.trace] == [a for a, _ in straight.trace]
 
 
+@pytest.mark.slow
 def test_device_bfs_final_checkpoint_on_capped_exit(tmp_path):
     """A depth/budget-capped run with checkpoint_path must leave a
     resumable file even when the periodic timer never fired (default

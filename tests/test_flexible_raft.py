@@ -43,6 +43,7 @@ def test_flexible_successor_sets_match_oracle():
         assert got == want, f"successor mismatch at state {b}"
 
 
+@pytest.mark.slow
 def test_flexible_bfs_counts_match_oracle():
     model = cached_model(FLEX)
     oracle = oracle_for(FLEX)

@@ -60,6 +60,7 @@ def test_encode_decode_roundtrip():
         assert model.decode(model.encode(st)) == st
 
 
+@pytest.mark.slow
 def test_bfs_counts_match_oracle():
     params = KRaftParams(
         n_servers=3, n_values=1, max_elections=1, max_restarts=0, msg_slots=40

@@ -215,6 +215,7 @@ def test_device_encode_decode_roundtrip():
         assert o.serialize_full(rt) == o.serialize_full(s)
 
 
+@pytest.mark.slow
 def test_device_successor_sets_match_oracle():
     """Successor-set differential on oracle-sampled reachable states
     (round-2 verdict item 4's 'done' bar)."""
@@ -233,6 +234,7 @@ def test_device_successor_sets_match_oracle():
         assert dev == ora, f"state {b}: +{len(dev - ora)} -{len(ora - dev)}"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("sym", [True, False])
 def test_device_bfs_counts_match_oracle(sym):
     """Bounded-depth BFS count parity through the slot canonicalizer
@@ -249,6 +251,7 @@ def test_device_bfs_counts_match_oracle(sym):
     assert dev.depth_counts == ores["depth_counts"]
 
 
+@pytest.mark.slow
 def test_device_symmetry_collapses_symmetric_init():
     """With a fully symmetric initial cluster (ics = H) the host
     permutations must collapse states exactly as the oracle's canon."""
@@ -271,6 +274,7 @@ def test_device_symmetry_collapses_symmetric_init():
     assert ores["distinct"] < nosym["distinct"]  # symmetry really reduces
 
 
+@pytest.mark.slow
 def test_device_cli_dispatch_tpu_checker():
     """--checker tpu now dispatches the reference cfg (device lowering
     replaces the round-1/2 'no TPU lowering yet' error path)."""

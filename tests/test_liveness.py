@@ -179,6 +179,7 @@ NoLogDivergence
 """
 
 
+@pytest.mark.slow
 def test_cli_property_clean_pass(tmp_path):
     """Raft spec with PROPERTY ValuesNotStuck enabled: safety BFS then a
     clean liveness pass over the full-state graph."""

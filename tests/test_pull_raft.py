@@ -57,6 +57,7 @@ def test_encode_decode_roundtrip(params):
         assert model.decode(model.encode(st)) == st
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("variant2", [False, True])
 def test_bfs_counts_match_oracle(variant2):
     params = PullRaftParams(

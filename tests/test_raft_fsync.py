@@ -63,6 +63,7 @@ def test_fsync_encode_decode_roundtrip():
         assert model.decode(model.encode(st)) == st
 
 
+@pytest.mark.slow
 def test_fsync_bfs_counts_match_oracle():
     params = fsync_params(False, True, True, max_elections=2, max_restarts=0)
     model = cached_model(params)

@@ -51,6 +51,7 @@ PARAMS = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("params", PARAMS)
 def test_successor_sets_match_oracle(params):
     model = cached_model(params)
@@ -77,6 +78,7 @@ def test_encode_decode_roundtrip():
         assert model.decode(model.encode(st)) == st
 
 
+@pytest.mark.slow
 def test_bfs_counts_match_oracle():
     params = PARAMS[0]
     model = cached_model(params)

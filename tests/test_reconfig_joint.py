@@ -87,6 +87,7 @@ def test_encode_decode_roundtrip():
         assert model.decode(model.encode(st)) == st
 
 
+@pytest.mark.slow
 def test_bfs_counts_match_oracle():
     params = PARAMS[0]
     model = cached_model(params)

@@ -15,6 +15,7 @@ from raft_tpu.parallel.sharded import ShardedBFS
 PARAMS = RaftParams(n_servers=2, n_values=1, max_elections=2, max_restarts=0, msg_slots=16)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("ndev", [4, 8])
 def test_sharded_counts_match_oracle(ndev):
     devices = jax.devices()[:ndev]
@@ -41,6 +42,7 @@ def test_sharded_counts_match_oracle(ndev):
     assert sum(m["a2a_lanes"] for m in res.metrics) > 0
 
 
+@pytest.mark.slow
 def test_sharded_substep_and_growth_parity():
     """Tiny chunk + tiny initial caps force the sub-stepping cursor (wave
     frontier > chunk) AND between-wave buffer growth; counts must still be
@@ -63,6 +65,7 @@ def test_sharded_substep_and_growth_parity():
     assert engine.FCAP > 32 or engine.SCAP > (1 << 8)  # growth actually ran
 
 
+@pytest.mark.slow
 def test_sharded_detects_violation_with_trace():
     import jax.numpy as jnp
 

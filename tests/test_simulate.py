@@ -2,6 +2,7 @@
 semantics, violation detection with trace replay, CLI integration."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,7 @@ def test_simulation_runs_clean_behaviors():
     assert res.steps > 100
 
 
+@pytest.mark.slow
 def test_simulation_finds_planted_violation_and_replays():
     """Plant a predicate that fails once any server is elected; random
     walks must find it quickly and the journal must replay to a labeled
