@@ -484,13 +484,15 @@ class DeviceBFS:
         match too — states explored before the checkpoint (including Init)
         were only checked against the original run's invariants, so a
         resume with different invariants would silently skip them."""
-        # hashv bumps when the fingerprint formula changes (v2: seeded
-        # families XOR a per-lane stream; seed=0 unchanged from v1)
-        hashv = 1 if self.canon.seed == 0 else 2
+        # hashv marks fingerprint-formula revisions for NONZERO seeds
+        # only (the v2 seeded families XOR a per-lane stream; the seed=0
+        # formula is bit-identical to v1, so seed-0 checkpoints keep the
+        # legacy key and remain resumable across the change)
+        hashv = "" if self.canon.seed == 0 else "/hashv=2"
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"
             f"/sym={self.canon.symmetry}/seed={self.canon.seed}"
-            f"/hashv={hashv}/inv={','.join(self.invariants)}"
+            f"{hashv}/inv={','.join(self.invariants)}"
         )
 
     def _save_checkpoint(

@@ -133,11 +133,18 @@ class LivenessChecker:
                     jax.device_get(fps_fn(flat)), dtype=np.uint64
                 )
                 vidx = np.nonzero(valid.reshape(-1))[0]
-                src_rows = []
-                dst_rows = []
-                cand_rows = []
-                for fi in vidx:
-                    fp = int(fps[fi])
+                if len(vidx) == 0:
+                    continue
+                vfps = fps[vidx]
+                # dict work only per UNIQUE fingerprint in the batch; edge
+                # arrays are built vectorized (the per-edge python loop
+                # dominated graph construction on big configs)
+                uniq, first_idx, inv = np.unique(
+                    vfps, return_index=True, return_inverse=True
+                )
+                gid_map = np.empty(len(uniq), np.int64)
+                for u_i in range(len(uniq)):
+                    fp = int(uniq[u_i])
                     g2 = gid_of.get(fp)
                     if g2 is None:
                         g2 = len(states)
@@ -147,15 +154,13 @@ class LivenessChecker:
                                 "smaller config (liveness needs the full graph)"
                             )
                         gid_of[fp] = g2
-                        states.append(flat[fi].copy())
+                        states.append(flat[vidx[first_idx[u_i]]].copy())
                         nxt.append(g2)
-                    src_rows.append(gids[fi // A])
-                    dst_rows.append(g2)
-                    cand_rows.append(fi % A)
-                if src_rows:
-                    edges_src.append(np.asarray(src_rows, np.int64))
-                    edges_dst.append(np.asarray(dst_rows, np.int64))
-                    edges_cand.append(np.asarray(cand_rows, np.int32))
+                    gid_map[u_i] = g2
+                gids_arr = np.asarray(gids, np.int64)
+                edges_src.append(gids_arr[vidx // A])
+                edges_dst.append(gid_map[inv])
+                edges_cand.append((vidx % A).astype(np.int32))
             frontier = nxt
 
         self._states = np.stack(states)

@@ -335,6 +335,13 @@ class JointRaftModel(ConfigRaftCommon):
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
+        # ReconfigurationCompletes — :1039-1054 (P ~> Q with the
+        # last-election-failed carve-out). checker/liveness.py runs it.
+        self.liveness = {
+            "ReconfigurationCompletes": [
+                ("", jax.jit(self._live_reconfig_p), jax.jit(self._live_reconfig_q)),
+            ],
+        }
 
     # ---------------- field access helpers ----------------
 
@@ -946,6 +953,65 @@ class JointRaftModel(ConfigRaftCommon):
         return vec
 
     # ---------------- invariants ----------------
+
+    def _old_new_committed(self, states):
+        """OldNewCommitted(i, index) over all (i, lane): committed
+        OldNewConfigCommand entries — :1023-1025. [B,S,L] mask."""
+        lay, L = self.layout, self.p.max_log
+        cmd = lay.get(states, "log_cmd")
+        ll = lay.get(states, "log_len")
+        ci = lay.get(states, "commitIndex")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        return (
+            (cmd == CMD_OLDNEW)
+            & (lanes[None, None, :] < ll[..., None])
+            & (ci[..., None] >= lanes[None, None, :] + 1)
+        )
+
+    def _live_reconfig_p(self, states):
+        """ReconfigurationCompletes antecedent — :1040-1043: some server
+        has a committed OldNewConfigCommand."""
+        return jnp.any(self._old_new_committed(states), axis=(1, 2))
+
+    def _live_reconfig_q(self, states):
+        """ReconfigurationCompletes consequent — :1044-1054: the last
+        permissible election failed leaderless, OR a majority of the new
+        member set are self-aware members in {Leader,Follower,Candidate}
+        holding the matching NewConfigCommand — :1027-1037."""
+        lay, S, L = self.layout, self.p.n_servers, self.p.max_log
+        st = lay.get(states, "state")
+        ec = lay.get(states, "electionCtr")
+        cmd = lay.get(states, "log_cmd")
+        cid = lay.get(states, "log_cid")
+        lnew = lay.get(states, "log_new")
+        ll = lay.get(states, "log_len")
+        cm = lay.get(states, "config_members")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        onc = self._old_new_committed(states)  # [B,S,L]
+        # server j qualifies for config id c: self-aware member, active
+        # state, and holds a NewConfigCommand with id c somewhere
+        iota = jnp.arange(S, dtype=jnp.int32)
+        self_member = ((cm >> iota[None, :]) & 1) > 0  # [B,S]
+        active = st != NOTMEMBER  # Leader/Follower/Candidate
+        has_new = (cmd == CMD_NEW) & (lanes[None, None, :] < ll[..., None])
+        # qualifies[b, j, i, l]: j holds NewConfigCommand with the id of
+        # entry (i, l)
+        id_match = jnp.any(
+            has_new[:, :, None, None, :]
+            & (cid[:, :, None, None, :] == cid[:, None, :, :, None]),
+            axis=4,
+        )  # [B,j,i,l]
+        qual = (self_member & active)[:, :, None, None] & id_match
+        # majority of the entry's NEW member set
+        new_bit = (
+            (lnew[:, None, :, :] >> iota[None, :, None, None]) & 1
+        ) > 0  # [B,j,i,l]
+        count = jnp.sum(qual & new_bit, axis=1)  # [B,i,l]
+        size = jnp.sum(new_bit, axis=1)  # [B,i,l]
+        reached = jnp.any(onc & (2 * count > size), axis=(1, 2))
+        no_leader = ~jnp.any(st == LEADER, axis=1)
+        spent = ec == self.p.max_elections
+        return (spent & no_leader) | reached
 
     def _inv_max_one_reconfig(self, states):
         """MaxOneReconfigurationAtATime — :1080-1101: same-type config

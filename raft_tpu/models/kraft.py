@@ -237,6 +237,15 @@ class KRaftModel:
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
+        # ValuesNotStuck == \A v : []<> ValueAllOrNothing(v)
+        # (KRaft.tla:867-879; same shape as core Raft's, checker/liveness.py)
+        self.liveness = {
+            "ValuesNotStuck": [
+                (self.value_names[v], None,
+                 jax.jit(partial(self._live_value_all_or_nothing, v)))
+                for v in range(V)
+            ],
+        }
 
     def action_label(self, rank: int, cand: int) -> str:
         name, binding = self.bindings[cand]
@@ -887,6 +896,24 @@ class KRaftModel:
         return vec
 
     # ---------------- invariants ----------------
+
+    def _live_value_all_or_nothing(self, v, states):
+        """ValueAllOrNothing(v) — KRaft.tla:867-875: TRUE when the last
+        permissible election failed with no leader, else v must be on
+        EVERY server log or on NONE."""
+        lay, L = self.layout, self.p.max_log
+        ec = lay.get(states, "electionCtr")
+        st = lay.get(states, "state")
+        lv = lay.get(states, "log_value")
+        ll = lay.get(states, "log_len")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_log = lanes[None, None, :] < ll[..., None]
+        has_v = jnp.any(in_log & (lv == v + 1), axis=2)
+        all_have = jnp.all(has_v, axis=1)
+        none_have = ~jnp.any(has_v, axis=1)
+        no_leader = ~jnp.any(st == LEADER, axis=1)
+        spent = ec == self.p.max_elections
+        return (spent & no_leader) | all_have | none_have
 
     def _inv_no_illegal(self, states):
         """NoIllegalState — KRaft.tla:887-889."""

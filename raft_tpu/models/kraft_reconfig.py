@@ -334,6 +334,25 @@ class KRaftReconfigModel:
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
 
+        # temporal properties (:1810-1839), checker/liveness.py:
+        # ValuesNotStuck = \A v : []<> CommittedValueOrNothing(v);
+        # ReconfigurationNotStuck = \A cid in 1..(MaxAdd+MaxRemove) :
+        # []<> ConfigAllOrNothing(cid)
+        self.liveness = {
+            "ValuesNotStuck": [
+                (self.value_names[v], None,
+                 jax.jit(partial(self._live_committed_value_or_nothing, v)))
+                for v in range(V)
+            ],
+            "ReconfigurationNotStuck": [
+                (f"config_id={cid}", None,
+                 jax.jit(partial(self._live_config_all_or_nothing, cid)))
+                for cid in range(
+                    1, params.max_add_reconfigs + params.max_remove_reconfigs + 1
+                )
+            ],
+        }
+
     def make_canonicalizer(self, symmetry: bool = True, seed: int = 0) -> "SlotCanonicalizer":
         return SlotCanonicalizer(self, symmetry, seed=seed)
 
@@ -1346,6 +1365,86 @@ class KRaftReconfigModel:
                 (b_bqreq, s_bqreq, KR_ACCEPT_BQREQ, jnp.asarray(False)),
             ],
         )
+
+
+    # -------- temporal-property kernels (:1775-1839) --------
+
+    def _no_progress_possible(self, states):
+        r"""NoProgressPossible — :1775-1781. The \E j conjunct compares
+        state[j] to the ROLE model value Voter (:1780), which no state
+        assignment ever produces — same quirk class as
+        RestartWithoutState:913 — so the ~\E i arm is vacuously TRUE and
+        the definition reduces to _electionCtr = MaxElections; reproduced
+        faithfully."""
+        ec = self.layout.get(states, "electionCtr")
+        return ec == self.p.max_elections
+
+    def _is_current_leader(self, states):
+        """IsCurrentLeader(i) — :1787-1792: Leader with no higher-epoch
+        peer. [B, NS] mask (used slots only)."""
+        lay = self.layout
+        used = lay.get(states, "used") > 0
+        st = lay.get(states, "state")
+        ep = lay.get(states, "currentEpoch")
+        higher = jnp.any(
+            used[:, None, :] & (ep[:, None, :] > ep[:, :, None]), axis=2
+        )
+        return used & (st == LEADER) & ~higher
+
+    def _live_committed_value_or_nothing(self, v, states):
+        """CommittedValueOrNothing(v) — :1794-1808: a current leader's
+        whole member set either has v committed or has v nowhere."""
+        lay, L, NS = self.layout, self.p.max_log, self.NS
+        cmd = lay.get(states, "log_cmd")
+        lv = lay.get(states, "log_val")
+        ll = lay.get(states, "log_len")
+        hwm = lay.get(states, "highWatermark")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        has = (
+            (lanes[None, None, :] < ll[..., None])
+            & (cmd == C_APPEND)
+            & (lv == v + 1)
+        )
+        in_log = jnp.any(has, axis=2)  # ValueNotInServerLog = ~in_log
+        committed = jnp.any(
+            has & (hwm[..., None] >= lanes[None, None, :] + 1), axis=2
+        )
+        return self._live_all_or_nothing(states, committed, in_log)
+
+    def _live_all_or_nothing(self, states, committed, in_log):
+        """Shared tail of the []<> formulas (:1804-1808 / :1829-1834):
+        NoProgressPossible, or some current leader whose whole member set
+        either has the thing committed or lacks it entirely. `committed`
+        and `in_log` are [B, NS] per-server presence masks."""
+        lay, NS = self.layout, self.NS
+        icl = self._is_current_leader(states)
+        member = (
+            (lay.get(states, "cfg_members")[:, :, None]
+             >> jnp.arange(NS, dtype=jnp.int32)[None, None, :]) & 1
+        ) > 0  # [B, l, i]
+        all_committed = jnp.all(~member | committed[:, None, :], axis=2)
+        all_absent = jnp.all(~member | ~in_log[:, None, :], axis=2)
+        ok = jnp.any(icl & (all_committed | all_absent), axis=1)
+        return self._no_progress_possible(states) | ok
+
+    def _live_config_all_or_nothing(self, cid, states):
+        """ConfigAllOrNothing(config_id) — :1817-1834."""
+        lay, L, NS = self.layout, self.p.max_log, self.NS
+        cmd = lay.get(states, "log_cmd")
+        cfgid = lay.get(states, "log_cfgid")
+        ll = lay.get(states, "log_len")
+        hwm = lay.get(states, "highWatermark")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        is_cfg = (
+            ((cmd == C_INIT) | (cmd == C_ADD) | (cmd == C_REMOVE))
+            & (lanes[None, None, :] < ll[..., None])
+            & (cfgid == cid)
+        )
+        in_log = jnp.any(is_cfg, axis=2)
+        committed = jnp.any(
+            is_cfg & (hwm[..., None] >= lanes[None, None, :] + 1), axis=2
+        )
+        return self._live_all_or_nothing(states, committed, in_log)
 
     # ---------------- full expansion ----------------
 

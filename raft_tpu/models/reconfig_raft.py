@@ -317,6 +317,13 @@ class ReconfigRaftModel(ConfigRaftCommon):
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
+        # ReconfigurationCompletes — :990-1005 (P ~> Q; the spec warns to
+        # use it with MaxElections = 0, :988). checker/liveness.py runs it.
+        self.liveness = {
+            "ReconfigurationCompletes": [
+                ("", jax.jit(self._live_reconfig_p), jax.jit(self._live_reconfig_q)),
+            ],
+        }
 
     # ---------------- field access helpers ----------------
 
@@ -931,6 +938,45 @@ class ReconfigRaftModel(ConfigRaftCommon):
         return vec
 
     # ---------------- invariants ----------------
+
+    def _live_reconfig_p(self, states):
+        """ReconfigurationCompletes antecedent — :992-996: some leader has
+        a config command in its log."""
+        lay, L = self.layout, self.p.max_log
+        st = lay.get(states, "state")
+        cmd = lay.get(states, "log_cmd")
+        ll = lay.get(states, "log_len")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        is_cfg = (
+            (cmd == CMD_INIT) | (cmd == CMD_ADD) | (cmd == CMD_REMOVE)
+        ) & (lanes[None, None, :] < ll[..., None])
+        return jnp.any((st == LEADER)[..., None] & is_cfg, axis=(1, 2))
+
+    def _live_reconfig_q(self, states):
+        """ReconfigurationCompletes consequent — :998-1005: some leader
+        has a config command that every member of that entry's member set
+        has replicated identically at the same index."""
+        lay, S, L = self.layout, self.p.n_servers, self.p.max_log
+        st = lay.get(states, "state")
+        cmd = lay.get(states, "log_cmd")
+        ll = lay.get(states, "log_len")
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        is_cfg = (
+            (cmd == CMD_INIT) | (cmd == CMD_ADD) | (cmd == CMD_REMOVE)
+        ) & (lanes[None, None, :] < ll[..., None])
+        # entry equality between server i and j at each lane: [B,S,S,L]
+        eq = jnp.ones(st.shape[:1] + (S, S, L), dtype=bool)
+        for n in ENTRY_FIELDS:
+            f = lay.get(states, f"log_{n}")
+            eq &= f[:, :, None, :] == f[:, None, :, :]
+        in_log_j = lanes[None, None, None, :] < ll[:, None, :, None]  # [B,1,S,L]
+        member_j = (
+            (lay.get(states, "log_cmembers")[:, :, None, :]
+             >> jnp.arange(S, dtype=jnp.int32)[None, None, :, None]) & 1
+        ) > 0  # [B,S(i),S(j),L]
+        ok_j = ~member_j | (in_log_j & eq)
+        complete = jnp.all(ok_j, axis=2)  # [B,S,L]
+        return jnp.any((st == LEADER)[..., None] & is_cfg & complete, axis=(1, 2))
 
     def _inv_max_one_reconfig(self, states):
         """MaxOneReconfigurationAtATime — :1031-1039."""
