@@ -1,0 +1,141 @@
+"""Shared machinery of the two reconfiguration-spec oracles.
+
+``joint_oracle.py`` and ``reconfig_oracle.py`` interpret near-identical
+TLA+ modules; their message-bag helpers, state-functional utilities and
+the BFS driver were byte-identical copies (round-2 verdict Weak #8).
+This base class holds them once. Everything where the two specs
+genuinely differ (quorum rules, LogOk strictness, reconfig actions,
+serialization of the differing entry shapes) stays in the subclasses —
+oracles are the differential ground truth, so faithfulness to each
+spec's text beats further deduplication.
+"""
+
+from __future__ import annotations
+
+
+class ConfigOracleBase:
+
+    @staticmethod
+    def _discard(msgs, m):
+        out = dict(msgs)
+        assert out.get(m, 0) > 0
+        out[m] -= 1
+        return frozenset(out.items())
+
+    def _set2(cls, mat, i, j, val) -> tuple:
+        return cls._set(mat, i, cls._set(mat[i], j, val))
+
+    def _domain(self, st):
+        return sorted((m for m, _c in st["messages"]), key=self._norm_rec)
+
+    # ---------- message-bag + state-functional helpers ----------
+
+    @staticmethod
+    def _msgs(st) -> dict:
+        return dict(st["messages"])
+
+    @staticmethod
+    def _send_multiple_once(msgs, ms):
+        if any(m in msgs for m in ms):
+            return None
+        out = dict(msgs)
+        for m in ms:
+            out[m] = 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _send_no_restriction(msgs, m):
+        out = dict(msgs)
+        out[m] = out.get(m, 0) + 1
+        return frozenset(out.items())
+
+    @staticmethod
+    def _send_once(msgs, m):
+        if m in msgs:
+            return None
+        out = dict(msgs)
+        out[m] = 1
+        return frozenset(out.items())
+
+    def _ser_msgs(self, msgs) -> tuple:
+        return tuple(sorted((self._norm_rec(m), c) for m, c in msgs))
+
+    @staticmethod
+    def _set(tup, i, val) -> tuple:
+        return tup[:i] + (val,) + tup[i + 1 :]
+
+    @staticmethod
+    def _with(st, **updates) -> dict:
+        out = dict(st)
+        out.update(updates)
+        return out
+
+    def bfs(
+        self,
+        invariants: tuple[str, ...] = (
+            "LeaderHasAllAckedValues",
+            "NoLogDivergence",
+            "MaxOneReconfigurationAtATime",
+        ),
+        symmetry: bool = True,
+        max_depth: int | None = None,
+        max_states: int | None = None,
+        time_budget_s: float | None = None,
+    ) -> dict:
+        import time
+
+        t0 = time.perf_counter()
+        init = self.init_state()
+        seen = {self.canon(init, symmetry)}
+        frontier = [init]
+        total = 1
+        distinct = 1
+        depth_counts = [1]
+        violation = None
+        depth = 0
+        while frontier and violation is None:
+            if max_states and distinct >= max_states:
+                break  # hard cap (the inner breaks alone admitted one
+                # extra state per depth level past the cap)
+            if max_depth is not None and depth >= max_depth:
+                break
+            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
+                break
+            next_frontier = []
+            for st in frontier:
+                for _label, s2 in self.successors(st):
+                    total += 1
+                    key = self.canon(s2, symmetry)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    distinct += 1
+                    for inv in invariants:
+                        if not self.INVARIANTS[inv](self, s2):
+                            violation = {
+                                "invariant": inv,
+                                "state": s2,
+                                "depth": depth + 1,
+                            }
+                            break
+                    next_frontier.append(s2)
+                    if violation or (max_states and distinct >= max_states):
+                        break
+                if violation or (max_states and distinct >= max_states):
+                    break
+                if (
+                    time_budget_s is not None
+                    and (total & 0x3FF) < 8
+                    and time.perf_counter() - t0 > time_budget_s
+                ):
+                    break
+            frontier = next_frontier
+            if frontier:
+                depth_counts.append(len(frontier))
+            depth += 1
+        return {
+            "distinct": distinct,
+            "total": total,
+            "depth_counts": depth_counts,
+            "violation": violation,
+        }

@@ -37,6 +37,8 @@ empty frozensets when not joint (absent record fields encode as empty).
 
 from __future__ import annotations
 
+from .config_oracle_base import ConfigOracleBase
+
 import itertools
 
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
@@ -94,7 +96,7 @@ def config_for(index: int, entry: tuple, ci: int) -> tuple:
     return (cfg_id, False, members, EMPTY_FS, EMPTY_FS, ci >= index)
 
 
-class JointRaftOracle:
+class JointRaftOracle(ConfigOracleBase):
     def __init__(
         self,
         n_servers: int,
@@ -158,39 +160,9 @@ class JointRaftOracle:
             "valueCtr": (0,) * self.max_term,
         }
 
-    @staticmethod
-    def _msgs(st) -> dict:
-        return dict(st["messages"])
-
-    @staticmethod
-    def _with(st, **updates) -> dict:
-        out = dict(st)
-        out.update(updates)
-        return out
-
-    @staticmethod
-    def _set(tup, i, val) -> tuple:
-        return tup[:i] + (val,) + tup[i + 1 :]
-
     @classmethod
-    def _set2(cls, mat, i, j, val) -> tuple:
-        return cls._set(mat, i, cls._set(mat[i], j, val))
 
     # ---------- message-bag helpers (:160-208) ----------
-
-    @staticmethod
-    def _send_no_restriction(msgs, m):
-        out = dict(msgs)
-        out[m] = out.get(m, 0) + 1
-        return frozenset(out.items())
-
-    @staticmethod
-    def _send_once(msgs, m):
-        if m in msgs:
-            return None
-        out = dict(msgs)
-        out[m] = 1
-        return frozenset(out.items())
 
     @classmethod
     def _send(cls, msgs, m):
@@ -201,28 +173,12 @@ class JointRaftOracle:
         return cls._send_no_restriction(msgs, m)
 
     @staticmethod
-    def _send_multiple_once(msgs, ms):
-        if any(m in msgs for m in ms):
-            return None
-        out = dict(msgs)
-        for m in ms:
-            out[m] = 1
-        return frozenset(out.items())
-
-    @staticmethod
     def _reply(msgs, response, request):
         out = dict(msgs)
         if out.get(request, 0) < 1:
             return None
         out[request] -= 1
         out[response] = out.get(response, 0) + 1
-        return frozenset(out.items())
-
-    @staticmethod
-    def _discard(msgs, m):
-        out = dict(msgs)
-        assert out.get(m, 0) > 0
-        out[m] -= 1
         return frozenset(out.items())
 
     def _receivable(self, st, m, mtype: str, equal_term: bool) -> bool:
@@ -253,9 +209,6 @@ class JointRaftOracle:
             raise TypeError(v)
 
         return tuple((k, norm_val(v)) for k, v in m)
-
-    def _domain(self, st):
-        return sorted((m for m, _c in st["messages"]), key=self._norm_rec)
 
     # ---------- config helpers ----------
 
@@ -887,9 +840,6 @@ class JointRaftOracle:
 
     # ---------- VIEW + SYMMETRY ----------
 
-    def _ser_msgs(self, msgs) -> tuple:
-        return tuple(sorted((self._norm_rec(m), c) for m, c in msgs))
-
     @staticmethod
     def _ser_log(log) -> tuple:
         def ser_entry(e):
@@ -1100,71 +1050,3 @@ class JointRaftOracle:
         "TestInv": lambda self, st: True,
     }
 
-    # ---------- BFS ----------
-
-    def bfs(
-        self,
-        invariants: tuple[str, ...] = (
-            "LeaderHasAllAckedValues",
-            "NoLogDivergence",
-            "MaxOneReconfigurationAtATime",
-        ),
-        symmetry: bool = True,
-        max_depth: int | None = None,
-        max_states: int | None = None,
-        time_budget_s: float | None = None,
-    ) -> dict:
-        import time
-
-        t0 = time.perf_counter()
-        init = self.init_state()
-        seen = {self.canon(init, symmetry)}
-        frontier = [init]
-        total = 1
-        distinct = 1
-        depth_counts = [1]
-        violation = None
-        depth = 0
-        while frontier and violation is None:
-            if max_depth is not None and depth >= max_depth:
-                break
-            if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
-                break
-            next_frontier = []
-            for st in frontier:
-                for _label, s2 in self.successors(st):
-                    total += 1
-                    key = self.canon(s2, symmetry)
-                    if key in seen:
-                        continue
-                    seen.add(key)
-                    distinct += 1
-                    for inv in invariants:
-                        if not self.INVARIANTS[inv](self, s2):
-                            violation = {
-                                "invariant": inv,
-                                "state": s2,
-                                "depth": depth + 1,
-                            }
-                            break
-                    next_frontier.append(s2)
-                    if violation or (max_states and distinct >= max_states):
-                        break
-                if violation or (max_states and distinct >= max_states):
-                    break
-                if (
-                    time_budget_s is not None
-                    and (total & 0x3FF) < 8
-                    and time.perf_counter() - t0 > time_budget_s
-                ):
-                    break
-            frontier = next_frontier
-            if frontier:
-                depth_counts.append(len(frontier))
-            depth += 1
-        return {
-            "distinct": distinct,
-            "total": total,
-            "depth_counts": depth_counts,
-            "violation": violation,
-        }

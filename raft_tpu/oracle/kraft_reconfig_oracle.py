@@ -48,6 +48,8 @@ from __future__ import annotations
 
 import itertools
 
+from .config_oracle_base import ConfigOracleBase
+
 # states (KRaftWithReconfig.tla:354-360) — string enums keep the oracle
 # readable; the lowering maps them to small ints
 UNATTACHED, FOLLOWER, CANDIDATE, LEADER, VOTED, RESIGNED, DEAD, ILLEGAL = (
@@ -144,7 +146,7 @@ def config_for(offset: int, entry: tuple, ci: int) -> tuple:
     return (val[0], val[-1], ci >= offset)
 
 
-class KRaftReconfigOracle:
+class KRaftReconfigOracle(ConfigOracleBase):
     def __init__(
         self,
         n_hosts: int,
@@ -206,15 +208,7 @@ class KRaftReconfigOracle:
         }
 
     @staticmethod
-    def _msgs(st) -> dict:
-        return dict(st["messages"])
-
     @staticmethod
-    def _with(st, **updates) -> dict:
-        out = dict(st)
-        out.update(updates)
-        return out
-
     @staticmethod
     def _setm(mapping: dict, i, val) -> dict:
         out = dict(mapping)
@@ -222,20 +216,6 @@ class KRaftReconfigOracle:
         return out
 
     # ---------- message-bag helpers (MessagePassing.tla) ----------
-
-    @staticmethod
-    def _send_no_restriction(msgs, m):
-        out = dict(msgs)
-        out[m] = out.get(m, 0) + 1
-        return frozenset(out.items())
-
-    @staticmethod
-    def _send_once(msgs, m):
-        if m in msgs:
-            return None
-        out = dict(msgs)
-        out[m] = 1
-        return frozenset(out.items())
 
     @classmethod
     def _send(cls, msgs, m):
@@ -247,14 +227,6 @@ class KRaftReconfigOracle:
         return cls._send_no_restriction(msgs, m)
 
     @staticmethod
-    def _send_multiple_once(msgs, ms):
-        if any(m in msgs for m in ms):
-            return None
-        out = dict(msgs)
-        for m in ms:
-            out[m] = 1
-        return frozenset(out.items())
-
     @staticmethod
     def _reply(msgs, response, request):
         """Reply — MessagePassing.tla:72-79: a FetchResponse may not be
@@ -266,13 +238,6 @@ class KRaftReconfigOracle:
             return None
         out[request] -= 1
         out[response] = out.get(response, 0) + 1
-        return frozenset(out.items())
-
-    @staticmethod
-    def _discard(msgs, m):
-        out = dict(msgs)
-        assert out.get(m, 0) > 0
-        out[m] -= 1
         return frozenset(out.items())
 
     def _receivable(self, st, m, mtype: str, equal_epoch: bool) -> bool:
