@@ -486,8 +486,10 @@ class DeviceBFS:
         resume with different invariants would silently skip them."""
         # hashv marks fingerprint-formula revisions for NONZERO seeds
         # only (the v2 seeded families XOR a per-lane stream; the seed=0
-        # formula is bit-identical to v1, so seed-0 checkpoints keep the
-        # legacy key and remain resumable across the change)
+        # FORMULA is bit-identical to v1). Note the ident string itself
+        # gained the /seed=/inv= suffix when this was introduced, so any
+        # checkpoint written before that change is refused on load either
+        # way — a conservative, sound invalidation.
         hashv = "" if self.canon.seed == 0 else "/hashv=2"
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"

@@ -89,7 +89,14 @@ class LivenessChecker:
         # FULL-state fingerprints, not the VIEW projection: aux counters
         # gate actions (electionCtr < MaxElections etc.) and the temporal
         # predicates read them, so VIEW-merged nodes would conflate states
-        # with different successor structure — unsound for liveness
+        # with different successor structure — unsound for liveness.
+        #
+        # Collision budget: graph dedup uses one 64-bit hash family, so a
+        # fingerprint collision would silently merge two states and could
+        # mask a temporal violation (expected collisions ~ n^2/2^65; at
+        # the 2M-state cap that is ~1e-7). Run run(audit_seed=k) to
+        # re-explore under a second seeded family and cross-check
+        # state/edge counts — a mismatch proves a collision in one family.
         self._fps = jax.jit(lambda v: hash_lanes(v))
 
     # ---------------- graph construction ----------------
@@ -289,10 +296,55 @@ class LivenessChecker:
 
     # ---------------- driver ----------------
 
-    def run(self, verbose: bool = False) -> LivenessResult:
+    def run(self, verbose: bool = False,
+            audit_seed: int | None = None) -> LivenessResult:
         t0 = time.perf_counter()
         self._explore()
         n = len(self._states)
+        if audit_seed is not None:
+            if audit_seed == 0:
+                # seed 0 IS the primary family (hashing.py): a 0-seed
+                # audit would vacuously compare a family against itself
+                raise ValueError("audit_seed must be nonzero (seed 0 is "
+                                 "the primary fingerprint family)")
+            # Two-seed collision audit: rebuild the graph under an
+            # independent hash family; a 64-bit collision in either
+            # family (merging two distinct states) shifts the
+            # state/edge counts with overwhelming probability.
+            base = (n, len(self._esrc))
+            saved = (self._fps, self._states, self._esrc, self._edst,
+                     self._ecand, self._n_init, getattr(self, "_fwd", None),
+                     getattr(self, "_rev", None))
+            self._fps = jax.jit(lambda v: hash_lanes(v, seed=audit_seed))
+            self._fwd = self._rev = None
+            try:
+                try:
+                    self._explore()
+                except OverflowError as e:
+                    # a collision in the PRIMARY family merges states, so
+                    # the audit family can see more true states and trip
+                    # the cap — that is collision evidence, not a capacity
+                    # problem
+                    raise RuntimeError(
+                        f"liveness collision audit (seed={audit_seed}) "
+                        f"overflowed where the primary family did not — "
+                        f"likely a fingerprint collision in the primary "
+                        f"family merged distinct states ({e})"
+                    ) from e
+                other = (len(self._states), len(self._esrc))
+            finally:
+                (self._fps, self._states, self._esrc, self._edst,
+                 self._ecand, self._n_init, self._fwd, self._rev) = saved
+            if other != base:
+                raise RuntimeError(
+                    f"liveness graph collision audit FAILED: primary family "
+                    f"saw {base[0]} states/{base[1]} edges, seed={audit_seed} "
+                    f"family saw {other[0]}/{other[1]} — a fingerprint "
+                    f"collision merged distinct states in one family"
+                )
+            if verbose:
+                print(f"liveness collision audit (seed={audit_seed}): OK "
+                      f"({n} states / {len(self._esrc)} edges both families)")
         if verbose:
             print(f"liveness graph: {n} states, {len(self._esrc)} edges")
         out_deg = np.bincount(self._esrc, minlength=n)

@@ -22,8 +22,8 @@ class ConfigOracleBase:
         out[m] -= 1
         return frozenset(out.items())
 
-    def _set2(cls, mat, i, j, val) -> tuple:
-        return cls._set(mat, i, cls._set(mat[i], j, val))
+    def _set2(self, mat, i, j, val) -> tuple:
+        return self._set(mat, i, self._set(mat[i], j, val))
 
     def _domain(self, st):
         return sorted((m for m, _c in st["messages"]), key=self._norm_rec)

@@ -160,8 +160,6 @@ class JointRaftOracle(ConfigOracleBase):
             "valueCtr": (0,) * self.max_term,
         }
 
-    @classmethod
-
     # ---------- message-bag helpers (:160-208) ----------
 
     @classmethod

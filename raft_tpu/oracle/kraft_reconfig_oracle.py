@@ -208,8 +208,6 @@ class KRaftReconfigOracle(ConfigOracleBase):
         }
 
     @staticmethod
-    @staticmethod
-    @staticmethod
     def _setm(mapping: dict, i, val) -> dict:
         out = dict(mapping)
         out[i] = val
@@ -226,7 +224,6 @@ class KRaftReconfigOracle(ConfigOracleBase):
             return cls._send_once(msgs, m)
         return cls._send_no_restriction(msgs, m)
 
-    @staticmethod
     @staticmethod
     def _reply(msgs, response, request):
         """Reply — MessagePassing.tla:72-79: a FetchResponse may not be

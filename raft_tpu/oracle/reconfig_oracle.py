@@ -176,8 +176,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
             "valueCtr": (0,) * self.max_term,
         }
 
-    @classmethod
-
     # ---------- message-bag helpers (:175-223) ----------
 
     @classmethod
