@@ -22,10 +22,20 @@ def main(argv=None):
     ap.add_argument(
         "--checker",
         default=os.environ.get("CHECKER", "tpu"),
-        choices=["tpu", "tpu-host", "oracle"],
-        help="backend: tpu (device-resident BFS), tpu-host (device "
-        "expansion + host dedup, the v1 driver), or oracle (pure-Python "
-        "reference)",
+        choices=["tpu", "sharded", "tpu-host", "oracle"],
+        help="backend: tpu (single-device BFS), sharded (multi-chip "
+        "frontier-sharded BFS over a device mesh — the `tlc -workers N` "
+        "replacement), tpu-host (device expansion + host dedup, the v1 "
+        "driver), or oracle (pure-Python reference)",
+    )
+    ap.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="mesh size for --checker sharded (default: all visible "
+        "devices; on CPU set XLA_FLAGS=--xla_force_host_platform_"
+        "device_count=N before launch to expose N virtual devices)",
     )
     ap.add_argument("--frontier-cap", type=int, default=None,
                     help="device frontier buffer rows (tpu checker)")
@@ -58,6 +68,16 @@ def main(argv=None):
         "silent hash-collision risk; tpu checker only)",
     )
     ap.add_argument("--chunk", type=int, default=1024, help="device batch size")
+    ap.add_argument(
+        "--profile",
+        type=int,
+        default=None,
+        metavar="DEPTH",
+        help="instead of checking, warm a BFS to DEPTH and print a per-"
+        "stage time breakdown of the chunk pipeline (expand / compact / "
+        "canonicalize / probe / run-emit / scatter / invariants; "
+        "SURVEY.md §5.1); tpu checker only",
+    )
     ap.add_argument(
         "--simulate",
         type=int,
@@ -150,7 +170,7 @@ def main(argv=None):
             )
             return 64
 
-    if args.checker in ("tpu", "tpu-host") and not hasattr(setup.model, "expand"):
+    if args.checker in ("tpu", "sharded", "tpu-host") and not hasattr(setup.model, "expand"):
         print(
             f"error: spec {setup.model.name} has no TPU lowering yet; use "
             "--checker oracle (exhaustive or --simulate)",
@@ -195,6 +215,22 @@ def main(argv=None):
                 file=sys.stderr,
             )
             return 70
+
+    if args.profile is not None:
+        if args.checker != "tpu" or args.simulate is not None:
+            print(
+                "error: --profile needs --checker tpu and no --simulate",
+                file=sys.stderr,
+            )
+            return 64
+        from .checker.profile import profile_stages, render
+
+        prof = profile_stages(
+            setup.model, invariants=setup.invariants, symmetry=symmetry,
+            chunk=args.chunk, warm_depth=args.profile, **cli_caps,
+        )
+        print(render(prof))
+        return 0
 
     if args.checker == "oracle" and args.simulate is not None:
         from .models.registry import oracle_for_setup
@@ -269,7 +305,31 @@ def main(argv=None):
         print("no invariant violations (simulation is not exhaustive)")
         return 0
 
-    if args.checker == "tpu":
+    if args.checker == "sharded":
+        import jax
+
+        from .parallel.sharded import ShardedBFS
+
+        devs = jax.devices()
+        if args.devices is not None:
+            if args.devices > len(devs):
+                print(
+                    f"error: --devices {args.devices} > {len(devs)} visible "
+                    "devices (on CPU expose more with XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N)",
+                    file=sys.stderr,
+                )
+                return 64
+            devs = devs[: args.devices]
+        checker = ShardedBFS(
+            setup.model,
+            invariants=setup.invariants,
+            symmetry=symmetry,
+            devices=devs,
+            chunk=args.chunk,
+            **cli_caps,
+        )
+    elif args.checker == "tpu":
         from .checker.device_bfs import DeviceBFS
 
         checker = DeviceBFS(
@@ -289,7 +349,7 @@ def main(argv=None):
             chunk=args.chunk,
         )
     run_kw = {}
-    if args.checker == "tpu":
+    if args.checker in ("tpu", "sharded"):
         run_kw = dict(
             checkpoint_path=args.checkpoint,
             checkpoint_every_s=args.checkpoint_every,
@@ -301,13 +361,19 @@ def main(argv=None):
         time_budget_s=args.time_budget,
         **run_kw,
     )
+    viol_name = (
+        res.violation_invariant if args.checker == "sharded"
+        else (res.violation.invariant if res.violation else None)
+    )
     print(
         f"distinct={res.distinct} total={res.total} depth={res.depth} "
         f"terminal={res.terminal} time={res.seconds:.2f}s "
         f"({res.states_per_sec:.0f} distinct/s)"
+        + (f" devices={checker.D}" if args.checker == "sharded" else "")
     )
-    if res.violation:
-        print(f"INVARIANT {res.violation.invariant} VIOLATED (depth {res.violation.depth})")
+    if viol_name:
+        vdepth = res.depth if args.checker == "sharded" else res.violation.depth
+        print(f"INVARIANT {viol_name} VIOLATED (depth {vdepth})")
         if res.trace:
             from .utils.pprint import format_trace
 

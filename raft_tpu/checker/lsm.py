@@ -1,0 +1,210 @@
+"""LSM of sorted fingerprint runs — the round-4 seen-set shared by the
+single-device (DeviceBFS) and sharded (ShardedBFS) checkers.
+
+Level i holds at most one sorted u64 run of ``min(R0 << i, TOPSZ)`` lanes
+(tail-padded with U64_MAX). Each chunk's new fingerprints enter at level
+0; two runs at the same level merge (sort-concat — measured faster than
+scatter-merges on this TPU) into the next level, exactly a binary
+counter; the TOPSZ top level absorbs by truncate-merge (sound only while
+the engine's capacity guard holds, see the callers). Probing costs one
+searchsorted per OCCUPIED level; per-chunk dedup cost is therefore
+independent of the total state count.
+
+Lanes live on the LAST axis: DeviceBFS uses [lanes] arrays, ShardedBFS
+[D, lanes] sharded arrays — the per-row sorts/concats are identical code,
+ShardedBFS just pins shardings via ``jit_kw``/``put``. The cascade is
+deterministic (occupancy-driven), so hosts can enqueue merges without
+syncing on run contents.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.hashing import U64_MAX
+
+
+def pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class RunLSM:
+    """``r0``: level-0 run lanes (a chunk's emission width, pow2);
+    ``topsz``: top-level lane cap (>= the engine's max seen capacity);
+    ``init_budget``: pre-create levels covering this many lanes so early
+    growth does not retrace the chunk program; ``lead_shape``: leading
+    batch axes of every run array (() or (D,)); ``put``: host->device
+    placement for empties (defaults to jnp.asarray); ``jit_kw``: extra
+    jax.jit kwargs for merge programs (e.g. out_shardings)."""
+
+    def __init__(self, r0: int, topsz: int, init_budget: int,
+                 lead_shape: tuple[int, ...] = (), put=None, jit_kw=None):
+        assert r0 and (r0 & (r0 - 1)) == 0, "r0 must be a power of two"
+        self.R0 = r0
+        self.TOPSZ = pow2_at_least(max(topsz, r0))
+        self._lead = lead_shape
+        self._put = put if put is not None else jnp.asarray
+        self._jit_kw = dict(jit_kw or {})
+        self._init_levels = 1
+        while self.lv_size(self._init_levels - 1) < min(init_budget, self.TOPSZ):
+            self._init_levels += 1
+        self._merge_cache: dict = {}
+        self._empty_cache: dict[int, object] = {}
+        self.runs: list = []
+        self.occ: list[bool] = []
+        self.reset()
+
+    # ---------------- geometry ----------------
+
+    def lv_size(self, level: int) -> int:
+        return min(self.R0 << level, self.TOPSZ)
+
+    def lanes(self) -> int:
+        """Occupied lanes (padding included) — the waste metric."""
+        return sum(
+            self.lv_size(i) for i in range(len(self.runs)) if self.occ[i]
+        )
+
+    def n_levels(self) -> int:
+        return len(self.runs)
+
+    # ---------------- internals ----------------
+
+    def _empty_of(self, size: int):
+        """Cached read-only all-U64_MAX run (levels share it; probing it
+        is harmless and merge inputs are never aliased with outputs)."""
+        if size not in self._empty_cache:
+            self._empty_cache[size] = self._put(
+                np.full(self._lead + (size,), np.uint64(U64_MAX))
+            )
+        return self._empty_cache[size]
+
+    def _jit(self, key, builder):
+        fn = self._merge_cache.get(key)
+        if fn is None:
+            fn = jax.jit(builder(), **self._jit_kw)
+            self._merge_cache[key] = fn
+        return fn
+
+    def _merge(self, a, b, out: int | None = None):
+        """Per-row sort-concat merge along the lane axis."""
+        key = (a.shape[-1], b.shape[-1], out)
+
+        def build():
+            if out is None:
+                return lambda x, y: jnp.sort(
+                    jnp.concatenate([x, y], axis=-1), axis=-1)
+            return lambda x, y: jnp.sort(
+                jnp.concatenate([x, y], axis=-1), axis=-1)[..., :out]
+
+        return self._jit(key, build)(a, b)
+
+    def _pad_run(self, run, size: int):
+        have = run.shape[-1]
+        if have == size:
+            return run
+        assert have < size
+
+        def build():
+            pad = size - have
+            return lambda r: jnp.concatenate(
+                [r, jnp.full(r.shape[:-1] + (pad,), U64_MAX, jnp.uint64)],
+                axis=-1)
+
+        return self._jit(("pad", have, size), build)(run)
+
+    # ---------------- operations ----------------
+
+    def reset(self, n_levels: int | None = None):
+        n = n_levels if n_levels is not None else self._init_levels
+        self.runs = [self._empty_of(self.lv_size(i)) for i in range(n)]
+        self.occ = [False] * n
+
+    def add_level(self) -> None:
+        """NOTE: changes the engine's chunk-program arg count (retrace)."""
+        self.runs.append(self._empty_of(self.lv_size(len(self.runs))))
+        self.occ.append(False)
+
+    def insert(self, run) -> None:
+        """Binary-counter insert of a sorted run (async device ops only —
+        the cascade is occupancy-driven, no host sync on run contents)."""
+        lv = 0
+        carry = run
+        while True:
+            if lv == len(self.runs):
+                self.add_level()
+            size = self.lv_size(lv)
+            if not self.occ[lv]:
+                self.runs[lv] = self._pad_run(carry, size)
+                self.occ[lv] = True
+                return
+            if size >= self.TOPSZ:
+                # absorb at the top: truncate-merge. Sound because the
+                # engine's pre-wave capacity guard ensures all real lanes
+                # fit in TOPSZ.
+                self.runs[lv] = self._merge(self.runs[lv], carry, out=size)
+                return
+            carry = self._merge(self.runs[lv], carry)
+            self.runs[lv] = self._empty_of(size)
+            self.occ[lv] = False
+            lv += 1
+
+    def consolidate(self, bound: int) -> None:
+        """Repack every occupied run into one right-sized run, dropping
+        sentinel padding (bounds probe count and lane waste). `bound`
+        must be an upper bound on the real fingerprints held per row; the
+        truncation is then safe (the engine's capacity guard keeps it
+        sound at TOPSZ)."""
+        occ_runs = [self.runs[i] for i in range(len(self.runs)) if self.occ[i]]
+        if len(occ_runs) <= 1:
+            return
+        target = min(max(self.R0, pow2_at_least(bound)), self.TOPSZ)
+        key = ("consol", tuple(r.shape[-1] for r in occ_runs), target)
+
+        def build():
+            return lambda *rs: jnp.sort(
+                jnp.concatenate(rs, axis=-1), axis=-1)[..., :target]
+
+        merged = self._jit(key, build)(*occ_runs)
+        lv = 0
+        while self.lv_size(lv) < target:
+            lv += 1
+        while lv >= len(self.runs):
+            self.add_level()
+        for i in range(len(self.runs)):
+            self.occ[i] = False
+            self.runs[i] = self._empty_of(self.lv_size(i))
+        self.runs[lv] = merged
+        self.occ[lv] = True
+
+    def seed(self, host_rows: np.ndarray) -> None:
+        """Start from a host array [*lead, n] of per-row sorted real
+        fingerprints padded with U64_MAX (Init seeding / resume)."""
+        n = host_rows.shape[-1]
+        if n > self.TOPSZ:
+            raise OverflowError(
+                f"seen-set seed of {n} lanes exceeds the {self.TOPSZ}-lane "
+                "capacity; raise max_seen_cap to at least the checkpoint's "
+                "seen size"
+            )
+        lv = 0
+        while self.lv_size(lv) < n:
+            lv += 1
+        self.reset(max(self._init_levels, lv + 1))
+        self.runs[lv] = self._pad_run(
+            self._put(host_rows.astype(np.uint64)), self.lv_size(lv)
+        )
+        self.occ[lv] = True
+
+    def export_host(self) -> list[np.ndarray]:
+        """Occupied runs fetched to host (engine filters/sorts them)."""
+        return [
+            np.asarray(jax.device_get(self.runs[i]))
+            for i in range(len(self.runs))
+            if self.occ[i]
+        ]

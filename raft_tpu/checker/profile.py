@@ -1,0 +1,284 @@
+"""Per-stage profiling of the DeviceBFS hot loop (SURVEY.md §5.1).
+
+The chunk pipeline is one fused XLA program in production; to attribute
+time we re-run each stage as its own jitted function on REAL buffers
+captured from a warmed run (a depth-capped run spills a checkpoint, and
+the profiler rebuilds the chunk inputs from it). Stages mirror
+``DeviceBFS._chunk_step`` 1:1:
+
+  null_dispatch  a no-op jit call: the dispatch/tunnel floor every other
+                 row also pays once (subtract it when reading raw ms)
+  expand       vmap of the per-action successor kernels
+  compact      valid-lane compaction (cumsum + one-hot select)
+  canon        VIEW + SYMMETRY canonical fingerprints (the P-permutation
+               reduction — the 5-server hot spot, SURVEY.md §7.2)
+  probe        membership probe of every LSM seen-run (searchsorted each)
+  run_emit     sorting the chunk's new fingerprints into its R0-lane run
+  scatter      next-frontier + journal scatter
+  invariants   batched invariant kernels
+  lsm_merge_2r0  one level-0 run merge (sort of 2*R0 lanes); the cascade
+                 triggers a level-l merge every 2^(l+1) chunks, so the
+                 AMORTIZED per-chunk merge cost (reported in per_wave_s)
+                 is a short geometric-ish series fitted from this point
+
+Per-wave cost model: chunks_per_wave * (fused chunk + amortized merge).
+``fused_chunk`` times the production program for cross-checking (the sum
+of stages normally OVERESTIMATES it — XLA fuses away intermediates).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.hashing import U64_MAX
+from .device_bfs import DeviceBFS
+from .util import probe_sorted as _probe
+
+
+def _time(fn, *args, reps: int = 5, inner: int = 1) -> float:
+    """Median wall seconds of fn(*args) with block_until_ready."""
+    out = fn(*args)
+    jax.block_until_ready(out)  # warm / compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) / inner)
+    return float(np.median(ts))
+
+
+def profile_stages(
+    model,
+    invariants: tuple[str, ...] = (),
+    symmetry: bool = True,
+    chunk: int = 1024,
+    frontier_cap: int = 1 << 17,
+    seen_cap: int = 1 << 21,
+    warm_depth: int = 8,
+    reps: int = 5,
+    **caps,
+) -> dict:
+    """Profile the chunk pipeline on a realistic frontier.
+
+    Runs a depth-capped BFS to ``warm_depth`` (checkpoint spill), then
+    rebuilds one representative chunk's inputs from the spill and times
+    each stage. Returns a dict with per-stage seconds, per-wave totals
+    and workload shape facts.
+    """
+    dev = DeviceBFS(
+        model, invariants=invariants, symmetry=symmetry, chunk=chunk,
+        frontier_cap=frontier_cap, seen_cap=seen_cap, **caps,
+    )
+    with tempfile.TemporaryDirectory() as td:
+        ck_path = os.path.join(td, "warm.npz")
+        res = dev.run(max_depth=warm_depth, checkpoint_path=ck_path)
+        if not os.path.exists(ck_path):
+            raise RuntimeError(
+                f"workload exhausted at depth {res.depth} < warm_depth="
+                f"{warm_depth}; no frontier left to profile"
+            )
+        ck = np.load(ck_path, allow_pickle=False)
+        frontier_h = np.asarray(ck["frontier"])  # [fcount, W]
+        seen_h = np.asarray(ck["seen"])  # [scount]
+    # caps may have grown during the warm run
+    C, A, W, VC = dev.chunk, dev.A, dev.W, dev.VC
+    FCAP, JCAP, R0 = dev.FCAP, dev.JCAP, dev.R0
+    fcount, scount = len(frontier_h), len(seen_h)
+
+    batch_h = frontier_h[:C]
+    if len(batch_h) < C:
+        batch_h = np.concatenate(
+            [batch_h, np.repeat(batch_h[-1:], C - len(batch_h), axis=0)]
+        )
+    batch = jnp.asarray(batch_h)
+    # the warmed seen-set as LSM runs (same layout production probes)
+    dev._lsm.seed(np.sort(seen_h.astype(np.uint64)))
+    runs = tuple(dev._lsm.runs)
+    occ_dev = jnp.asarray(np.asarray(dev._lsm.occ, dtype=bool))
+    occ_runs = tuple(r for r, o in zip(dev._lsm.runs, dev._lsm.occ) if o)
+
+    out: dict = {
+        "workload": {
+            "model": model.name,
+            "warm_depth": int(res.depth),
+            "frontier": int(fcount),
+            "seen": int(scount),
+            "distinct": int(res.distinct),
+        },
+        "geometry": {
+            "chunk": C, "A": A, "W": W, "VC": VC, "R0": R0,
+            "FCAP": FCAP, "JCAP": JCAP, "lsm_levels": len(runs),
+            "perms": int(dev.canon.P), "symmetry": bool(symmetry),
+        },
+        "stages_s": {},
+    }
+    st = out["stages_s"]
+
+    # ---- stage 0: dispatch floor ----
+    null_j = jax.jit(lambda x: x + 1)
+    st["null_dispatch"] = _time(null_j, jnp.zeros((8,), jnp.int32), reps=reps)
+
+    # ---- stage 1: expand ----
+    expand = jax.jit(lambda b: jax.vmap(model._expand1)(b))
+    st["expand"] = _time(expand, batch, reps=reps)
+    succs, valid, _rank, _ovf = expand(batch)
+
+    # ---- stage 2: compact ----
+    def compact(succs, valid):
+        vflat = valid.reshape(-1)
+        vpos = jnp.cumsum(vflat) - 1
+        sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+        sel = (
+            jnp.full((VC + 1,), C * A, jnp.int32)
+            .at[sdst]
+            .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+        )
+        selv = sel < C * A
+        flatp = jnp.concatenate(
+            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
+        )
+        return flatp[sel], selv
+
+    compact_j = jax.jit(compact)
+    st["compact"] = _time(compact_j, succs, valid, reps=reps)
+    flatc, selv = compact_j(succs, valid)
+
+    # ---- stage 3: canonical fingerprints ----
+    canon_j = jax.jit(dev.canon._fingerprints)
+    st["canon"] = _time(canon_j, flatc, reps=reps)
+    fps = jnp.where(selv, canon_j(flatc), U64_MAX)
+
+    # ---- stage 4: probe the occupied LSM runs (production skips empty
+    # levels via cond, so the occupied set is what a chunk pays for) ----
+    def probe_all(f, *rs):
+        hit = jnp.zeros(f.shape, bool)
+        for r in rs:
+            hit = hit | _probe(r, f)
+        return hit
+
+    st["probe"] = _time(jax.jit(probe_all), fps, *occ_runs, reps=reps)
+
+    # ---- stage 5: emit the chunk's sorted run ----
+    def run_emit(f):
+        nr = jnp.sort(f)
+        if R0 > VC:
+            nr = jnp.concatenate(
+                [nr, jnp.full((R0 - VC,), U64_MAX, jnp.uint64)]
+            )
+        return nr
+
+    st["run_emit"] = _time(jax.jit(run_emit), fps, reps=reps)
+
+    # ---- stage 5b: scatter into frontier + journal ----
+    def scatter(flatc, fps):
+        new = fps != U64_MAX
+        npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
+        bdst = jnp.where(new, jnp.minimum(npos, FCAP), FCAP)
+        nb = jnp.zeros((FCAP + 1, W), jnp.int32).at[bdst].set(flatc)
+        jdst = jnp.where(new, jnp.minimum(npos, JCAP), JCAP)
+        jp = jnp.zeros((JCAP + 1,), jnp.int32).at[jdst].set(bdst)
+        return nb, jp
+
+    st["scatter"] = _time(jax.jit(scatter), flatc, fps, reps=reps)
+
+    # ---- stage 6: invariants ----
+    if invariants:
+        inv_j = jax.jit(
+            lambda v: [model.invariants[n](v) for n in invariants]
+        )
+        st["invariants"] = _time(inv_j, flatc, reps=reps)
+    else:
+        st["invariants"] = 0.0
+
+    # ---- LSM merge costs (level 0 measured; series fitted n log n) ----
+    r0a = run_emit(fps)
+    st["lsm_merge_2r0"] = _time(
+        jax.jit(lambda a, b: jnp.sort(jnp.concatenate([a, b]))), r0a, r0a,
+        reps=reps,
+    )
+    null = st["null_dispatch"]
+    a_fit = max(st["lsm_merge_2r0"] - null, 1e-6) / (2 * R0 * math.log2(2 * R0))
+    n_levels = max(1, len(runs))
+    amortized = sum(
+        a_fit * (R0 << (l + 1)) * math.log2(R0 << (l + 1)) / (1 << (l + 1))
+        for l in range(n_levels)
+    )
+
+    # ---- the fused production program, for cross-check ----
+    frontier_d = jnp.asarray(
+        np.concatenate([
+            frontier_h,
+            np.zeros((FCAP + 1 - fcount, W), np.int32),
+        ])
+    )
+
+    def fused_once():
+        # donated args (next_buf, journal, viol, stats) must be rebuilt
+        # per call — donation invalidates their buffers
+        nb = jnp.zeros((FCAP + 1, W), jnp.int32)
+        jp = jnp.zeros((JCAP + 1,), jnp.int32)
+        jc = jnp.zeros((JCAP + 1,), jnp.int32)
+        viol = jnp.full((max(1, len(invariants)),), np.int32(2**31 - 1), jnp.int32)
+        stats = jnp.zeros((5,), jnp.int64)
+        args = [frontier_d, nb, jp, jc, viol, stats,
+                np.int32(0), np.int32(min(fcount, C)), np.int32(0),
+                occ_dev, *runs]
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        r = dev._chunk_fn(*args)
+        jax.block_until_ready(r)
+        return time.perf_counter() - t0
+
+    fused_once()  # compile
+    st["fused_chunk"] = float(np.median([fused_once() for _ in range(reps)]))
+
+    chunk_sum = sum(
+        st[k] for k in
+        ("expand", "compact", "canon", "probe", "run_emit", "scatter",
+         "invariants")
+    ) - 7 * null  # each stage row pays one dispatch
+    n_chunks = max(1, (fcount + C - 1) // C)
+    per_chunk = st["fused_chunk"] + amortized
+    out["per_wave_s"] = {
+        "chunks_per_wave": n_chunks,
+        "stage_sum_per_chunk": round(chunk_sum, 6),
+        "fused_per_chunk": round(st["fused_chunk"], 6),
+        "lsm_merge_amortized_per_chunk": round(amortized, 6),
+        "wave_estimate": round(n_chunks * per_chunk, 6),
+        "merge_share": round(amortized / per_chunk, 4),
+    }
+    return out
+
+
+def render(prof: dict) -> str:
+    w, g, s = prof["workload"], prof["geometry"], prof["stages_s"]
+    lines = [
+        f"workload: {w['model']} depth={w['warm_depth']} "
+        f"frontier={w['frontier']} seen={w['seen']}",
+        f"geometry: chunk={g['chunk']} VC={g['VC']} R0={g.get('R0')} "
+        f"FCAP={g['FCAP']} lsm_levels={g.get('lsm_levels')} "
+        f"perms={g['perms']}",
+        f"{'stage':<16}{'ms':>10}{'share':>8}",
+    ]
+    skip = ("fused_chunk", "lsm_merge_2r0", "null_dispatch")
+    null = s.get("null_dispatch", 0.0)
+    tot = sum(max(0.0, v - null) for k, v in s.items() if k not in skip)
+    for k, v in s.items():
+        share = max(0.0, v - null) / tot if k not in skip and tot else 0
+        lines.append(f"{k:<16}{v * 1e3:>10.2f}{share:>8.1%}")
+    pw = prof["per_wave_s"]
+    lines.append(
+        f"wave: {pw['chunks_per_wave']} chunks x "
+        f"({pw['fused_per_chunk']*1e3:.2f} ms fused + "
+        f"{pw['lsm_merge_amortized_per_chunk']*1e3:.2f} ms amortized merge)"
+    )
+    return "\n".join(lines)

@@ -1,4 +1,4 @@
-"""Sharded-frontier BFS over a ``jax.sharding.Mesh`` (v2).
+"""Sharded-frontier BFS over a ``jax.sharding.Mesh`` (v3).
 
 The TPU-native replacement for TLC's shared-memory worker threads
 (``tlc -workers N``, SURVEY.md §5.8): each chip owns the slice of
@@ -9,21 +9,29 @@ chunk is one ``shard_map``-ed program per chip:
     slice `chunk` frontier rows -> expand (vmap over per-action kernels)
     -> compact valid successor lanes -> canonical fingerprints -> route
     each candidate to its owner chip (``fp mod D``) via ``jax.lax.
-    all_to_all`` over ICI -> local dedup (sorted seen-set + in-wave
-    buffer probe, first-occurrence) -> append survivors to the local
-    next-frontier and their (parent shard, parent lgid, candidate) rows
-    to the local journal -> batched invariant evaluation folding the
-    first-violating journal index per invariant.
+    all_to_all`` over ICI -> local dedup (probe the chip's LSM seen-runs,
+    first-occurrence) -> append survivors to the local next-frontier and
+    their (parent shard, parent lgid, candidate) rows to the local
+    journal -> batched invariant evaluation folding the first-violating
+    journal index per invariant -> emit the chip's new fingerprints as
+    one sorted run.
+
+The per-chip seen-set is the same LSM of sorted runs as DeviceBFS
+(round-4 redesign, see checker/device_bfs.py): runs live as [D, lanes]
+sharded arrays so every merge/consolidation is a batched per-chip sort
+with no collectives; the binary-counter cascade is identical on every
+chip (all chips insert one run per chunk), so one host-side occupancy
+drives the whole mesh. This removes the per-chunk FCAP-lane sort and the
+per-wave SCAP-lane finalize of v2 — per-chunk dedup cost is independent
+of total state count.
 
 Parent pointers cross shards (a successor's owner is unrelated to its
 parent's shard), so journal entries address states as (shard, local gid);
 the parent shard is implicit in the all-to-all block structure (received
 rows [d*RC:(d+1)*RC] came from chip d) and is never routed.
 
-All buffers are fixed-capacity (XLA static shapes) but GROW between waves
-(4x when a wave ends within 3x of capacity, same policy as DeviceBFS);
-overflow flags abort rather than drop states. Multi-host scale-out is the
-same collective over DCN (mesh spanning hosts).
+Checkpoint/resume (round-4 verdict Next #3): same .npz scheme as
+DeviceBFS with per-shard arrays; a resume must use the same mesh size.
 
 State counts are exact and deterministic; within-wave discovery ORDER
 differs from the sequential driver (first-occurrence tie-breaking is by
@@ -42,6 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..checker.lsm import RunLSM, pow2_at_least
 from ..checker.util import (
     GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
 )
@@ -67,7 +76,7 @@ class ShardedResult:
 
 
 class ShardedBFS:
-    """Multi-chip exhaustive BFS with per-chip frontier/seen-set/journal.
+    """Multi-chip exhaustive BFS with per-chip frontier/seen-runs/journal.
 
     Capacities (all per device):
       chunk          frontier states expanded per chunk step
@@ -77,12 +86,13 @@ class ShardedBFS:
                      overflow impossible (a chunk yields at most VC
                      candidates, all of which could share one owner)
       frontier_cap   per-wave distinct states (grows, multiple of chunk)
-      seen_cap       distinct states owned by the chip (grows)
+      seen_cap       initial per-chip LSM lane budget (bound: max_seen_cap)
       journal_cap    journal rows = owned distinct states beyond Init
     """
 
     GROWTH = GROWTH
     HEADROOM = HEADROOM
+    CONSOL_EVERY = 16  # chunk inserts between mid-wave LSM repacks
 
     def __init__(
         self,
@@ -109,64 +119,99 @@ class ShardedBFS:
         self.A = model.A
         self.W = model.layout.W
         self.VC = min(chunk * self.A, chunk * valid_per_state)
+        # a chunk receives at most D*RC routed lanes; RC defaults to VC
         self.RC = route_cap if route_cap is not None else self.VC
         frontier_cap = ((frontier_cap + chunk - 1) // chunk) * chunk
         self.FCAP = frontier_cap
-        self.SCAP = seen_cap
-        # journal rows ~= owned distinct states, same order as the seen
-        # set; start small and let _maybe_grow enlarge it
         self.JCAP = journal_cap if journal_cap is not None else seen_cap
         self.MAX_FCAP = max(max_frontier_cap, frontier_cap)
         self.MAX_SCAP = max(max_seen_cap, seen_cap)
         self.MAX_JCAP = max(max_journal_cap, self.JCAP)
+        # LSM geometry: a chunk inserts the D*RC received lanes' worth of
+        # new fps at most, but only its own VC-compacted candidates can
+        # be new — the run size is the receive width. Shared
+        # implementation (checker/lsm.py): runs are [D, lanes] sharded
+        # arrays, merges are collective-free per-chip sorts.
+        self.R0 = pow2_at_least(self.D * self.RC)
+        self.SCAP = self.MAX_SCAP
         self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         self._sharding = NamedSharding(self.mesh, P(AXIS))
+        self._lsm = RunLSM(
+            r0=self.R0, topsz=pow2_at_least(self.MAX_SCAP),
+            init_budget=seen_cap, lead_shape=(self.D,),
+            put=lambda h: jax.device_put(h, self._sharding),
+            jit_kw={"out_shardings": self._sharding},
+        )
+        self.TOPSZ = self._lsm.TOPSZ
 
-        spec = P(AXIS)
-        self._chunk_fn = jax.jit(
-            jax.shard_map(
-                self._chunk_step,
-                mesh=self.mesh,
-                in_specs=(spec,) * 10 + (P(), spec),
-                out_specs=(spec,) * 7,
-            ),
-            # donated: next_buf, wave_fps, jps, jpl, jcand, viol, stats
-            # (frontier/fcount/seen are reused across the wave's chunks)
-            donate_argnums=(3, 4, 5, 6, 7, 8, 9),
-        )
-        self._finalize_fn = jax.jit(
-            jax.shard_map(
-                self._finalize,
-                mesh=self.mesh,
-                in_specs=(spec, spec, spec),
-                out_specs=(spec, spec, spec),
-            ),
-            donate_argnums=(0, 1, 2),
-        )
+        self._chunk_fn_cache: dict[int, object] = {}
         self._journals = None  # (jps, jpl, jcand) per shard after run()
         self._init_by_shard = None
 
+    # ---------------- LSM adapters (per-chip [D, lanes] runs) ----
+
+    def _lsm_export(self) -> list[np.ndarray]:
+        """Per-chip sorted real fingerprints (checkpoint format)."""
+        parts = self._lsm.export_host()
+        out = []
+        for d in range(self.D):
+            cat = (
+                np.concatenate([p[d] for p in parts])
+                if parts else np.empty(0, np.uint64)
+            )
+            cat = cat[cat != np.uint64(U64_MAX)]
+            cat.sort()
+            out.append(cat)
+        return out
+
+    def _lsm_seed(self, per_shard: list[np.ndarray]):
+        n = max((len(a) for a in per_shard), default=0)
+        h = np.full((self.D, max(n, 1)), np.uint64(U64_MAX))
+        for d, a in enumerate(per_shard):
+            h[d, : len(a)] = np.sort(a.astype(np.uint64))
+        self._lsm.seed(h)
+
     # ---------------- device programs (per chip under shard_map) ----------
 
+    def _get_chunk_fn(self, n_runs: int):
+        """jit(shard_map) per LSM level count (the runs tuple is part of
+        the program signature)."""
+        fn = self._chunk_fn_cache.get(n_runs)
+        if fn is None:
+            spec = P(AXIS)
+            fn = jax.jit(
+                jax.shard_map(
+                    self._chunk_step,
+                    mesh=self.mesh,
+                    in_specs=(spec,) * 8 + (P(), P(), spec) + (spec,) * n_runs,
+                    out_specs=(spec,) * 7,
+                ),
+                # donated: next_buf, jps, jpl, jcand, viol, stats
+                donate_argnums=(2, 3, 4, 5, 6, 7),
+            )
+            self._chunk_fn_cache[n_runs] = fn
+        return fn
+
     def _chunk_step(
-        self, frontier, fcount, seen, next_buf, wave_fps,
-        jps, jpl, jcand, viol, stats, cursor, base_lgid,
+        self, frontier, fcount, next_buf, jps, jpl, jcand, viol, stats,
+        cursor, occ, base_lgid, *runs,
     ):
         """One chunk of the current wave on one chip.
 
-        frontier [1,F+1,W]; fcount/base_lgid [1,1]; seen [1,SC] sorted u64;
-        next_buf [1,F+1,W]; wave_fps [1,F+1]; jps/jpl/jcand [1,JC+1];
-        viol [1,K]; stats [1,S] i64 =
+        frontier [1,F+1,W]; fcount/base_lgid [1,1]; next_buf [1,F+1,W];
+        jps/jpl/jcand [1,JC+1]; viol [1,K]; occ bool[L] (replicated);
+        runs: L sharded [1,lanes] sorted u64; stats [1,S] i64 =
         [wave new, jcount, cum generated, cum terminal, ovf bits, routed lanes].
+        Returns (+ new_run [1,R0]).
         """
         model, D, A, W = self.model, self.D, self.A, self.W
         C, VC, RC = self.chunk, self.VC, self.RC
         F, JC = self.FCAP, self.JCAP
         # strip the leading local-block axis shard_map hands us
-        frontier, fcount, seen, base_lgid = (
-            frontier[0], fcount[0, 0], seen[0], base_lgid[0, 0])
-        next_buf, wave_fps = next_buf[0], wave_fps[0]
+        frontier, fcount, base_lgid = frontier[0], fcount[0, 0], base_lgid[0, 0]
+        next_buf = next_buf[0]
         jps, jpl, jcand, viol, stats = jps[0], jpl[0], jcand[0], viol[0], stats[0]
+        runs = [r[0] for r in runs]
 
         # 1. expand `chunk` rows starting at the wave cursor
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
@@ -223,13 +268,23 @@ class ShardedBFS:
         recv_pay = lax.all_to_all(send_pay, AXIS, 0, 0, tiled=True)
         recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
 
-        # 6. local dedup: seen-set + in-wave buffer + first-occurrence
+        # 6. local dedup: probe the occupied LSM runs + first-occurrence
         sidx = jnp.argsort(recv_fps, stable=True)
         rf = recv_fps[sidx]
         uniq = jnp.ones_like(rf, dtype=bool).at[1:].set(rf[1:] != rf[:-1])
-        in_seen = _probe(seen, rf)
-        in_wave = _probe(wave_fps, rf)
-        new = uniq & ~in_seen & ~in_wave & (rf != U64_MAX)
+        fresh = uniq & (rf != U64_MAX)
+        for i, r in enumerate(runs):
+            hit = lax.cond(
+                occ[i],
+                lambda rr: _probe(rr, rf),
+                # rf != rf: an all-False array that carries the same
+                # varying-manual-axes type as the true branch (a plain
+                # jnp.zeros is unvarying and cond rejects the mismatch)
+                lambda rr: rf != rf,
+                r,
+            )
+            fresh = fresh & ~hit
+        new = fresh
         n_new = jnp.sum(new)
 
         # 7. scatter survivors into next frontier + journal
@@ -245,9 +300,13 @@ class ShardedBFS:
         jps = jps.at[jdst].set((sidx // RC).astype(jnp.int32))
         jpl = jpl.at[jdst].set(recv_pay[sidx, W])
         jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
-        wave_fps = jnp.sort(
-            jnp.concatenate([wave_fps, jnp.where(new, rf, U64_MAX)])
-        )[: F + 1]
+        # the chip's new fps as one sorted run (LSM level-0 insert)
+        new_run = jnp.sort(jnp.where(new, rf, U64_MAX))
+        DRC = new_run.shape[0]
+        if self.R0 > DRC:
+            new_run = jnp.concatenate(
+                [new_run, jnp.full((self.R0 - DRC,), U64_MAX, jnp.uint64)]
+            )
 
         # 8. invariants on the received candidates; fold first-bad jidx
         jidx = jnp.where(new, jcount + npos, I32_MAX)
@@ -274,27 +333,18 @@ class ShardedBFS:
             ]
         )
         return (
-            next_buf[None], wave_fps[None], jps[None], jpl[None],
-            jcand[None], viol[None], stats[None],
+            next_buf[None], jps[None], jpl[None], jcand[None], viol[None],
+            stats[None], new_run[None],
         )
-
-    def _finalize(self, seen, wave_fps, stats):
-        """End of wave: union wave fingerprints into the seen-set, reset
-        the wave buffer and the per-wave counter."""
-        seen, wave_fps, stats = seen[0], wave_fps[0], stats[0]
-        merged = jnp.sort(jnp.concatenate([seen, wave_fps]))[: self.SCAP]
-        fresh = jnp.full((self.FCAP + 1,), U64_MAX, jnp.uint64)
-        stats = stats.at[0].set(0)
-        return merged[None], fresh[None], stats[None]
 
     # ---------------- capacity growth (between waves, host-mediated) ------
 
-    def _maybe_grow(self, state, fcounts, scounts, jcounts):
+    def _maybe_grow(self, state, fcounts, jcounts):
         """Host-side: fetch, pad, re-place any buffer the next wave could
         outgrow. Rare (4x growth), so the host round-trip is acceptable;
-        the jitted programs retrace automatically at the new shapes."""
+        the jitted programs retrace automatically at the new shapes. The
+        seen-set needs no growth — LSM levels appear on demand."""
         ncount = int(fcounts.max())
-        sc = int(scounts.max())
         jc = int(jcounts.max())
         D, W = self.D, self.W
 
@@ -311,14 +361,7 @@ class ShardedBFS:
             repad("frontier", new + 1, self.FCAP + 1, 0, cols=W)
             state["next_buf"] = jax.device_put(
                 np.zeros((D, new + 1, W), np.int32), self._sharding)
-            state["wave_fps"] = jax.device_put(
-                np.full((D, new + 1), np.uint64(U64_MAX)), self._sharding)
             self.FCAP = new
-        if sc + ncount * self.HEADROOM > self.SCAP and self.SCAP < self.MAX_SCAP:
-            new = _next_cap(sc + ncount * self.HEADROOM, self.SCAP,
-                            self.MAX_SCAP, self.GROWTH, 1)
-            repad("seen", new, self.SCAP, np.uint64(U64_MAX))
-            self.SCAP = new
         if jc + ncount * self.HEADROOM > self.JCAP and self.JCAP < self.MAX_JCAP:
             new = _next_cap(jc + ncount * self.HEADROOM, self.JCAP,
                             self.MAX_JCAP, self.GROWTH, 1)
@@ -326,6 +369,55 @@ class ShardedBFS:
                 repad(key, new + 1, self.JCAP + 1, 0)
             self.JCAP = new
         return state
+
+    # ---------------- checkpoint ----------------
+
+    def _ckpt_ident(self) -> str:
+        return (
+            f"sharded/{self.model.name}/{self.model.p}/W={self.W}"
+            f"/D={self.D}/sym={self.canon.symmetry}/hashv=3"
+            f"/inv={','.join(self.invariants)}"
+        )
+
+    def _save_checkpoint(
+        self, path, state, fcounts, scounts, jcounts, n0, base_lgid,
+        distinct, total, terminal, depth, gen_prev, routed_prev, depth_counts,
+    ):
+        import os
+
+        seen = self._lsm_export()
+        assert [len(s) for s in seen] == [int(x) for x in scounts], (
+            "LSM export does not match per-shard scounts"
+        )
+        fmax = int(fcounts.max())
+        jmax = int(jcounts.max())
+        smax = max((len(s) for s in seen), default=0)
+        seen_h = np.full((self.D, smax), np.uint64(U64_MAX))
+        for d, s in enumerate(seen):
+            seen_h[d, : len(s)] = s
+        frontier_h = np.asarray(jax.device_get(state["frontier"]))[:, :fmax]
+        tmp = f"{path}.tmp.npz"
+        np.savez(
+            tmp,
+            version=1,
+            spec=self._ckpt_ident(),
+            fcounts=fcounts, scounts=scounts, jcounts=jcounts,
+            n0=n0, base_lgid=base_lgid,
+            frontier=frontier_h,
+            seen=seen_h,
+            jps=np.asarray(jax.device_get(state["jps"]))[:, :jmax],
+            jpl=np.asarray(jax.device_get(state["jpl"]))[:, :jmax],
+            jcand=np.asarray(jax.device_get(state["jcand"]))[:, :jmax],
+            init_by_shard_flat=np.concatenate(
+                [np.stack(s) if s else np.zeros((0, self.W), np.int32)
+                 for s in self._init_by_shard], axis=0),
+            init_by_shard_count=np.asarray(
+                [len(s) for s in self._init_by_shard], np.int64),
+            distinct=distinct, total=total, terminal=terminal, depth=depth,
+            gen_prev=gen_prev, routed_prev=routed_prev,
+            depth_counts=np.asarray(depth_counts, dtype=np.int64),
+        )
+        os.replace(tmp, path)
 
     # ---------------- host driver ----------------
 
@@ -335,12 +427,14 @@ class ShardedBFS:
         verbose: bool = False,
         time_budget_s: float | None = None,
         collect_metrics: bool = False,
+        checkpoint_path: str | None = None,
+        checkpoint_every_s: float = 300.0,
+        resume: str | None = None,
     ) -> ShardedResult:
         model, D, W, C = self.model, self.D, self.W, self.chunk
         t0 = time.perf_counter()
         exhausted = True
 
-        # ---- init states, assigned to owner shards by fp mod D ----
         init = np.asarray(model.init_states())
         init_fps = np.asarray(
             jax.device_get(self.canon.fingerprints(init)), dtype=np.uint64)
@@ -353,58 +447,122 @@ class ShardedBFS:
         keep[order[dupm]] = False
         init_d, init_fps = init[keep], init_fps[keep]
 
-        frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
-        seen_h = np.full((D, self.SCAP), np.uint64(U64_MAX))
-        fcounts = np.zeros(D, np.int64)
-        self._init_by_shard = [[] for _ in range(D)]
-        for k in range(len(init_d)):
-            d = int(init_fps[k] % D)
-            frontier_h[d, fcounts[d]] = init_d[k]
-            seen_h[d, fcounts[d]] = init_fps[k]
-            self._init_by_shard[d].append(np.asarray(init_d[k]))
-            fcounts[d] += 1
-        seen_h.sort(axis=1)
-        scounts = fcounts.copy()
-        jcounts = np.zeros(D, np.int64)
-        n0 = fcounts.copy()  # per-shard init count (lgid < n0[d] => init)
-        base_lgid = np.zeros(D, np.int64)
-
         violation = None
         viol_site = None  # (shard, lgid)
         init_trace = None  # one-entry trace for a depth-0 violation
-        viol_init = self._check_init(init_d)
-        if viol_init is not None:
-            violation, bad_idx = viol_init
-            init_trace = [("Initial predicate", model.decode(init_d[bad_idx]))]
 
-        state = {
-            "frontier": jax.device_put(frontier_h, self._sharding),
-            "next_buf": jax.device_put(
-                np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
-            "seen": jax.device_put(seen_h, self._sharding),
-            "wave_fps": jax.device_put(
-                np.full((D, self.FCAP + 1), np.uint64(U64_MAX)), self._sharding),
-            "jps": jax.device_put(
-                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
-            "jpl": jax.device_put(
-                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
-            "jcand": jax.device_put(
-                np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
-            "viol": jax.device_put(
-                np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
-                self._sharding),
-            "stats": jax.device_put(
-                np.zeros((D, 6), np.int64), self._sharding),
-        }
+        if resume is not None:
+            ck = np.load(resume, allow_pickle=False)
+            ident = self._ckpt_ident()
+            if str(ck["spec"]) != ident:
+                raise ValueError(
+                    f"checkpoint is for spec {ck['spec']}, checker is {ident}"
+                )
+            fcounts = np.asarray(ck["fcounts"], np.int64)
+            scounts = np.asarray(ck["scounts"], np.int64)
+            jcounts = np.asarray(ck["jcounts"], np.int64)
+            n0 = np.asarray(ck["n0"], np.int64)
+            base_lgid = np.asarray(ck["base_lgid"], np.int64)
+            fmax, jmax = int(fcounts.max()), int(jcounts.max())
+            self.FCAP = _next_cap(max(self.FCAP, fmax * self.HEADROOM),
+                                  self.FCAP, self.MAX_FCAP, self.GROWTH, self.chunk)
+            self.JCAP = _next_cap(max(self.JCAP, jmax + fmax * self.HEADROOM),
+                                  self.JCAP, self.MAX_JCAP, self.GROWTH, 1)
+            frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
+            frontier_h[:, :fmax] = ck["frontier"]
+            jh = {k: np.zeros((D, self.JCAP + 1), np.int32) for k in
+                  ("jps", "jpl", "jcand")}
+            for k in jh:
+                jh[k][:, :jmax] = ck[k]
+            seen_h = np.asarray(ck["seen"])
+            self._lsm_seed(
+                [seen_h[d, : scounts[d]] for d in range(D)]
+            )
+            counts = np.asarray(ck["init_by_shard_count"])
+            flat = np.asarray(ck["init_by_shard_flat"])
+            self._init_by_shard = []
+            off = 0
+            for d in range(D):
+                self._init_by_shard.append(
+                    [flat[off + i] for i in range(int(counts[d]))])
+                off += int(counts[d])
+            distinct = int(ck["distinct"])
+            total = int(ck["total"])
+            terminal = int(ck["terminal"])
+            depth = int(ck["depth"])
+            gen_prev = int(ck["gen_prev"])
+            routed_prev = int(ck["routed_prev"])
+            depth_counts = list(ck["depth_counts"])
+            # per-shard generated/terminal/routed cums are not persisted
+            # per shard; resume them as deltas from zero and add the saved
+            # totals back via the *_base offsets
+            stats_h0 = np.zeros((D, 6), np.int64)
+            stats_h0[:, 1] = jcounts
+            gen_base, term_base, routed_base = gen_prev, terminal, routed_prev
+            gen_prev = routed_prev = terminal = 0
+            state = {
+                "frontier": jax.device_put(frontier_h, self._sharding),
+                "next_buf": jax.device_put(
+                    np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
+                "jps": jax.device_put(jh["jps"], self._sharding),
+                "jpl": jax.device_put(jh["jpl"], self._sharding),
+                "jcand": jax.device_put(jh["jcand"], self._sharding),
+                "viol": jax.device_put(
+                    np.full((D, max(1, len(self.invariants))), I32_MAX,
+                            np.int32), self._sharding),
+                "stats": jax.device_put(stats_h0, self._sharding),
+            }
+        else:
+            frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
+            fcounts = np.zeros(D, np.int64)
+            self._init_by_shard = [[] for _ in range(D)]
+            per_shard_fps: list[list[int]] = [[] for _ in range(D)]
+            for k in range(len(init_d)):
+                d = int(init_fps[k] % D)
+                frontier_h[d, fcounts[d]] = init_d[k]
+                per_shard_fps[d].append(init_fps[k])
+                self._init_by_shard[d].append(np.asarray(init_d[k]))
+                fcounts[d] += 1
+            self._lsm_seed(
+                [np.asarray(a, np.uint64) for a in per_shard_fps]
+            )
+            scounts = fcounts.copy()
+            jcounts = np.zeros(D, np.int64)
+            n0 = fcounts.copy()  # per-shard init count (lgid < n0[d] => init)
+            base_lgid = np.zeros(D, np.int64)
+            gen_base = term_base = routed_base = 0
 
-        distinct = int(len(init_d))
-        total = int(len(init))  # pre-dedup, matching BFSChecker seeding
-        terminal = 0
-        gen_prev = 0
-        routed_prev = 0
-        depth = 0
-        depth_counts = [distinct]
+            viol_init = self._check_init(init_d)
+            if viol_init is not None:
+                violation, bad_idx = viol_init
+                init_trace = [("Initial predicate", model.decode(init_d[bad_idx]))]
+
+            state = {
+                "frontier": jax.device_put(frontier_h, self._sharding),
+                "next_buf": jax.device_put(
+                    np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
+                "jps": jax.device_put(
+                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                "jpl": jax.device_put(
+                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                "jcand": jax.device_put(
+                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                "viol": jax.device_put(
+                    np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
+                    self._sharding),
+                "stats": jax.device_put(
+                    np.zeros((D, 6), np.int64), self._sharding),
+            }
+            distinct = int(len(init_d))
+            total = int(len(init))  # pre-dedup, matching BFSChecker seeding
+            terminal = 0
+            gen_prev = 0
+            routed_prev = 0
+            depth = 0
+            depth_counts = [distinct]
+
         metrics: list[dict] | None = [] if collect_metrics else None
+        last_ckpt = time.perf_counter()
 
         while fcounts.sum() and violation is None:
             if max_depth is not None and depth >= max_depth:
@@ -413,22 +571,50 @@ class ShardedBFS:
             if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 exhausted = False
                 break
+            # top-absorb capacity guard, per chip (see DeviceBFS.run):
+            # conservative — a chip's wave-new count is bounded by FCAP
+            # and by the WHOLE mesh's routed candidates (fp%D routing can
+            # send every chip's successors to one owner)
+            worst = int(scounts.max()) + min(self.FCAP, int(fcounts.sum()) * self.VC)
+            if worst > self.TOPSZ:
+                if checkpoint_path is not None:
+                    self._save_checkpoint(
+                        checkpoint_path, state, fcounts, scounts,
+                        jcounts, n0, base_lgid, distinct, total,
+                        terminal + term_base, depth,
+                        gen_prev + gen_base, routed_prev + routed_base,
+                        depth_counts,
+                    )
+                raise OverflowError(
+                    "sharded seen-set capacity overflow; raise max_seen_cap"
+                )
             tw = time.perf_counter()
             fc_dev = jax.device_put(
                 fcounts.astype(np.int32).reshape(D, 1), self._sharding)
             bl_dev = jax.device_put(
                 base_lgid.astype(np.int32).reshape(D, 1), self._sharding)
             max_fc = int(fcounts.max())
+            chunks_done = 0
             for cursor in range(0, max_fc, C):
-                (state["next_buf"], state["wave_fps"], state["jps"],
-                 state["jpl"], state["jcand"], state["viol"], state["stats"],
-                 ) = self._chunk_fn(
-                    state["frontier"], fc_dev, state["seen"],
-                    state["next_buf"], state["wave_fps"], state["jps"],
-                    state["jpl"], state["jcand"], state["viol"],
-                    state["stats"], np.int32(cursor), bl_dev,
+                occ_dev = jnp.asarray(np.asarray(self._lsm.occ, dtype=bool))
+                chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
+                (state["next_buf"], state["jps"], state["jpl"],
+                 state["jcand"], state["viol"], state["stats"], new_run,
+                 ) = chunk_fn(
+                    state["frontier"], fc_dev, state["next_buf"],
+                    state["jps"], state["jpl"], state["jcand"],
+                    state["viol"], state["stats"], np.int32(cursor),
+                    occ_dev, bl_dev, *self._lsm.runs,
                 )
-            stats_h = np.asarray(jax.device_get(state["stats"]))  # [D,6]
+                self._lsm.insert(new_run)
+                chunks_done += 1
+                if chunks_done % self.CONSOL_EVERY == 0:
+                    self._lsm.consolidate(
+                        int(scounts.max()) + chunks_done * self.D * self.RC
+                    )
+            stats_h, viol_h = jax.device_get((state["stats"], state["viol"]))
+            stats_h = np.asarray(stats_h)  # [D,6]
+            viol_h = np.asarray(viol_h)  # [D,K]
             new_d = stats_h[:, 0]
             ovf_bits = int(np.bitwise_or.reduce(stats_h[:, 4]))
             if ovf_bits:
@@ -436,8 +622,6 @@ class ShardedBFS:
                     f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
                     "1=msg-slots 2=valid_per_state 4=route_cap "
                     "8=frontier_cap 16=journal_cap)")
-            if np.any(scounts + new_d > self.SCAP):
-                raise OverflowError("sharded seen-set overflow; raise seen_cap")
             global_new = int(new_d.sum())
             n_gen_cum = int(stats_h[:, 2].sum())
             wave_gen = n_gen_cum - gen_prev
@@ -454,26 +638,41 @@ class ShardedBFS:
             base_lgid = n0 + stats_h[:, 1] - new_d
             scounts += new_d
             jcounts = stats_h[:, 1].copy()
-            if self.invariants:
-                viol_h = np.asarray(jax.device_get(state["viol"]))  # [D,K]
-                if (viol_h != I32_MAX).any():
-                    # first violated invariant (cfg order), lowest jidx,
-                    # lowest shard as the tie-break
-                    for k, name in enumerate(self.invariants):
-                        col = viol_h[:, k]
-                        if (col != I32_MAX).any():
-                            d = int(np.argmin(col))
-                            violation = name
-                            viol_site = (d, int(n0[d] + col[d]))
-                            break
-            (state["seen"], state["wave_fps"], state["stats"]
-             ) = self._finalize_fn(state["seen"], state["wave_fps"], state["stats"])
+            if self.invariants and (viol_h != I32_MAX).any():
+                # first violated invariant (cfg order), lowest jidx,
+                # lowest shard as the tie-break
+                for k, name in enumerate(self.invariants):
+                    col = viol_h[:, k]
+                    if (col != I32_MAX).any():
+                        d = int(np.argmin(col))
+                        violation = name
+                        viol_site = (d, int(n0[d] + col[d]))
+                        break
+            # reset the wave-new counter (stats was donated; rebuild)
+            stats_h2 = stats_h.copy()
+            stats_h2[:, 0] = 0
+            state["stats"] = jax.device_put(stats_h2, self._sharding)
             state["frontier"], state["next_buf"] = (
                 state["next_buf"], state["frontier"])
             prev_fcounts = fcounts
             fcounts = new_d.copy()
             if violation is None:
-                state = self._maybe_grow(state, fcounts, scounts, jcounts)
+                state = self._maybe_grow(state, fcounts, jcounts)
+                # per-chip floor is smaller than DeviceBFS's (1<<21):
+                # each chip holds ~1/D of the space
+                if self._lsm.lanes() > max(4 * int(scounts.max()), 1 << 20):
+                    self._lsm.consolidate(int(scounts.max()))
+                if (
+                    checkpoint_path is not None
+                    and time.perf_counter() - last_ckpt > checkpoint_every_s
+                ):
+                    self._save_checkpoint(
+                        checkpoint_path, state, fcounts, scounts, jcounts,
+                        n0, base_lgid, distinct, total, terminal + term_base,
+                        depth, gen_prev + gen_base,
+                        routed_prev + routed_base, depth_counts,
+                    )
+                    last_ckpt = time.perf_counter()
             if metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
@@ -487,6 +686,7 @@ class ShardedBFS:
                     "a2a_lanes": wave_routed,
                     "a2a_bytes": wave_routed * (4 * (W + 2) + 8),
                     "shard_new": [int(x) for x in new_d],
+                    "lsm_runs": sum(self._lsm.occ),
                 }
                 if metrics is not None:
                     metrics.append(wm)
@@ -496,6 +696,14 @@ class ShardedBFS:
                         f"a2a={wave_routed} lanes "
                         f"balance={new_d.min()}/{new_d.max()} "
                         f"({distinct/el:.0f} distinct/s)")
+
+        if (checkpoint_path is not None and violation is None
+                and not exhausted):
+            self._save_checkpoint(
+                checkpoint_path, state, fcounts, scounts, jcounts, n0,
+                base_lgid, distinct, total, terminal + term_base, depth,
+                gen_prev + gen_base, routed_prev + routed_base, depth_counts,
+            )
 
         # fetch journals for trace reconstruction
         jps_h = np.asarray(jax.device_get(state["jps"]))
@@ -515,7 +723,7 @@ class ShardedBFS:
             violation_invariant=violation,
             seconds=dt,
             states_per_sec=distinct / dt if dt > 0 else 0.0,
-            terminal=terminal,
+            terminal=terminal + term_base,
             exhausted=exhausted and violation is None,
             trace=trace,
             metrics=metrics,
@@ -556,5 +764,3 @@ class ShardedBFS:
             out.append(
                 (model.action_label(int(rank[cand]), cand), model.decode(state)))
         return out
-
-

@@ -1,0 +1,50 @@
+"""Strong-CPU-baseline arm for bench.py (round-4 verdict Next #5).
+
+Runs the SAME DeviceBFS engine on the XLA CPU backend (vectorized,
+single-core on this host) over the same depth-capped workload, excluding
+compile time the same way the TPU arm does. Prints one JSON line:
+  {"depth": N, "distinct": N, "seconds": S, "platform": "cpu"}
+
+Invoked as a subprocess because the JAX platform is process-global.
+Usage: python scripts/cpu_baseline.py <cfg> <cmp_depth> <chunk> <msg_slots>
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    cfg_path, cmp_depth, chunk, msg_slots = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+    from raft_tpu.checker.device_bfs import DeviceBFS
+
+    cfg = parse_cfg(cfg_path)
+    setup = build_from_cfg(cfg, msg_slots=msg_slots)
+    dev = DeviceBFS(
+        setup.model, invariants=setup.invariants, symmetry=True, chunk=chunk,
+        frontier_cap=1 << 18, seen_cap=1 << 22, journal_cap=1 << 22,
+    )
+    dev.run(max_depth=2)  # compile outside the timed window (same as TPU arm)
+    t0 = time.perf_counter()
+    res = dev.run(max_depth=cmp_depth)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "depth": res.depth,
+        "distinct": res.distinct,
+        "depth_counts": res.depth_counts,
+        "seconds": round(dt, 2),
+        "platform": "cpu",
+    }))
+
+
+if __name__ == "__main__":
+    main()

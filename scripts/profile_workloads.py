@@ -1,0 +1,100 @@
+"""Stage-level profile of the three verdict workloads -> PROFILE.md.
+
+Workloads (round-3 verdict Next #1):
+  raft3   standard-raft Raft.cfg           (3 servers, 6 perms)
+  fsync   raft-and-fsync RaftFsync.cfg     (3 servers, 6 perms)
+  raft5   Raft 5s/5v/MaxTerm5 (BENCH row2) (5 servers, 120 perms)
+
+Usage: python scripts/profile_workloads.py [raft3 fsync raft5] [--platform cpu]
+Writes PROFILE.md + PROFILE.json at the repo root.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+REF = "/root/reference/specifications"
+
+
+def _model_raft3():
+    from raft_tpu.models.registry import build_from_cfg
+    from raft_tpu.utils.cfg import parse_cfg
+
+    s = build_from_cfg(parse_cfg(f"{REF}/standard-raft/Raft.cfg"), msg_slots=32)
+    return s.model, s.invariants, dict(chunk=4096, frontier_cap=1 << 18,
+                                       seen_cap=1 << 22, warm_depth=14)
+
+
+def _model_fsync():
+    from raft_tpu.models.registry import build_from_cfg
+    from raft_tpu.utils.cfg import parse_cfg
+
+    s = build_from_cfg(parse_cfg(f"{REF}/raft-and-fsync/RaftFsync.cfg"),
+                       msg_slots=40)
+    return s.model, s.invariants, dict(chunk=2048, frontier_cap=1 << 18,
+                                       seen_cap=1 << 22, warm_depth=11)
+
+
+def _model_raft5():
+    from raft_tpu.models.raft import RaftParams, cached_model
+
+    p = RaftParams(n_servers=5, n_values=5, max_elections=4, max_restarts=0,
+                   msg_slots=64)
+    return (cached_model(p),
+            ("LeaderHasAllAckedValues", "NoLogDivergence"),
+            dict(chunk=2048, frontier_cap=1 << 19, seen_cap=1 << 23,
+                 warm_depth=7))
+
+
+WL = {"raft3": _model_raft3, "fsync": _model_fsync, "raft5": _model_raft5}
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if "--platform" in sys.argv:
+        plat = sys.argv[sys.argv.index("--platform") + 1]
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    from raft_tpu.checker.profile import profile_stages, render
+
+    pick = args or list(WL)
+    out_json = os.path.join(ROOT, "PROFILE.json")
+    results = {}
+    if os.path.exists(out_json):
+        with open(out_json) as f:
+            results = json.load(f)
+    import jax
+
+    results["meta"] = {"device": str(jax.devices()[0]),
+                       "when": time.strftime("%Y-%m-%d %H:%M:%S")}
+    for name in pick:
+        model, invs, kw = WL[name]()
+        print(f"=== {name} ===", flush=True)
+        prof = profile_stages(model, invariants=invs, symmetry=True, **kw)
+        results[name] = prof
+        print(render(prof), flush=True)
+        with open(out_json, "w") as f:
+            json.dump(results, f, indent=1)
+
+    md = ["# Stage-level profile of the DeviceBFS hot loop",
+          "",
+          f"Device: {results['meta']['device']} "
+          f"({results['meta']['when']}). Produced by "
+          "`python scripts/profile_workloads.py`; stage semantics in "
+          "`raft_tpu/checker/profile.py`. Shares are of the per-chunk "
+          "stage sum (fused_chunk / finalize_merge are separate rows: "
+          "the fused production program and the per-WAVE seen merge).",
+          ""]
+    for name in pick:
+        md += [f"## {name}", "", "```", render(results[name]), "```", ""]
+    with open(os.path.join(ROOT, "PROFILE.md"), "w") as f:
+        f.write("\n".join(md))
+    print("wrote PROFILE.md / PROFILE.json")
+
+
+if __name__ == "__main__":
+    main()

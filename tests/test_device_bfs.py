@@ -117,7 +117,9 @@ def test_device_bfs_capacity_growth():
         max_journal_cap=1 << 17,
     )
     res = grown.run()
-    assert grown.FCAP > 256 and grown.SCAP > 512 and grown.JCAP > 512
+    assert grown.FCAP > 256 and grown.JCAP > 512
+    # the LSM seen-set grows by occupying levels, not by resizing SCAP
+    assert grown._lsm.lanes() > 512
     assert res.distinct == ref.distinct
     assert res.depth_counts == ref.depth_counts
     assert res.total == ref.total

@@ -1,0 +1,190 @@
+"""Property tests for the v3 canonical fingerprint (ops/symmetry.py):
+sort-free multiset bag hashing + signature-pruned permutation min.
+
+The correctness contract (module docstring there):
+  - the per-server signature is permutation-EQUIVARIANT,
+  - the fast signature-argsort path is bit-identical to the brute-force
+    masked min over the full S! table (mode="full"),
+  - fingerprints are orbit-invariant and separate orbits exactly like
+    the oracle's canonical view (TLC's SYMMETRY semantics,
+    ``Raft.tla:116``).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from raft_tpu.models.pull_raft import PullRaftModel, PullRaftParams
+from raft_tpu.models.raft import RaftModel, RaftParams
+from raft_tpu.oracle.pull_oracle import PullRaftOracle
+from raft_tpu.oracle.raft_oracle import RaftOracle
+from raft_tpu.ops.hashing import U64_MAX
+from raft_tpu.ops.symmetry import Canonicalizer
+
+from conftest import collect_states
+
+
+def raft3():
+    p = RaftParams(n_servers=3, n_values=1, max_elections=1, max_restarts=1,
+                   msg_slots=24)
+    return RaftModel(p), RaftOracle(p.n_servers, p.n_values, p.max_elections,
+                                    p.max_restarts)
+
+
+def raft5():
+    p = RaftParams(n_servers=5, n_values=2, max_elections=2, max_restarts=0,
+                   msg_slots=48)
+    return RaftModel(p), RaftOracle(p.n_servers, p.n_values, p.max_elections,
+                                    p.max_restarts)
+
+
+def pull3():
+    p = PullRaftParams(n_servers=3, n_values=1, max_elections=2,
+                       max_restarts=0, msg_slots=24)
+    return (PullRaftModel(p),
+            PullRaftOracle(p.n_servers, p.n_values, p.max_elections,
+                           p.max_restarts))
+
+
+CASES = {"raft3": raft3, "raft5": raft5, "pull3": pull3}
+
+
+def canon_pair(model):
+    auto = Canonicalizer.for_model(model, symmetry=True)
+    full = Canonicalizer(
+        model.layout, model.packer,
+        msg_server_fields=getattr(model, "msg_server_fields",
+                                  ("msource", "mdest")),
+        msg_server_nil_fields=getattr(model, "msg_server_nil_fields", ()),
+        msg_perm_spec=getattr(model, "msg_perm_spec", None),
+        symmetry=True, mode="full",
+    )
+    return auto, full
+
+
+def states_of(name, depth=4, cap=150):
+    model, oracle = CASES[name]()
+    states = collect_states(oracle, max_depth=depth, cap=cap)
+    vecs = np.stack([model.encode(st) for st in states])
+    return model, oracle, states, vecs
+
+
+@pytest.mark.parametrize("name", list(CASES))
+def test_auto_equals_bruteforce(name):
+    model, _oracle, _states, vecs = states_of(name)
+    auto, full = canon_pair(model)
+    fa = np.asarray(auto.fingerprints(vecs))
+    fb = np.asarray(full.fingerprints(vecs))
+    assert np.array_equal(fa, fb)
+    assert not np.any(fa == U64_MAX)
+
+
+@pytest.mark.parametrize("name", ["raft3", "raft5"])
+def test_auto_equals_bruteforce_tie_heavy(name):
+    # a batch of replicated Init states is 100% signature-tied with
+    # S-sized tie groups, forcing the lax.cond full-table branch
+    # (heavy lanes > B//8); interleave with distinct states so every
+    # tier lands in one batch
+    model, _oracle, _states, vecs = states_of(name, depth=3, cap=40)
+    reps = np.repeat(model.init_states(), 200, axis=0)
+    batch = np.concatenate([reps, vecs, reps], axis=0)
+    auto, full = canon_pair(model)
+    fa = np.asarray(auto.fingerprints(batch))
+    fb = np.asarray(full.fingerprints(batch))
+    assert np.array_equal(fa, fb)
+
+
+@pytest.mark.parametrize("name", list(CASES))
+def test_orbit_invariance(name):
+    model, oracle, states, vecs = states_of(name)
+    auto, _ = canon_pair(model)
+    fps = np.asarray(auto.fingerprints(vecs))
+    S = model.layout.n_servers
+    rng = np.random.default_rng(7)
+    sigmas = [list(rng.permutation(S)) for _ in range(4)]
+    for sigma in sigmas:
+        pvecs = np.stack(
+            [model.encode(oracle.permute(st, sigma)) for st in states]
+        )
+        pfps = np.asarray(auto.fingerprints(pvecs))
+        assert np.array_equal(fps, pfps), f"sigma={sigma}"
+
+
+@pytest.mark.parametrize("name", ["raft3", "pull3"])
+def test_signature_equivariance(name):
+    # sig(perm(x))[sigma[i]] == sig(x)[i] for every reachable sample state
+    model, oracle, states, vecs = states_of(name)
+    auto, _ = canon_pair(model)
+    S = model.layout.n_servers
+    sig = np.asarray(auto._signatures(vecs[:, : auto.VL]))
+    for sigma in itertools.permutations(range(S)):
+        pvecs = np.stack(
+            [model.encode(oracle.permute(st, list(sigma))) for st in states]
+        )
+        psig = np.asarray(auto._signatures(pvecs[:, : auto.VL]))
+        assert np.array_equal(psig[:, list(sigma)], sig), f"sigma={sigma}"
+
+
+@pytest.mark.parametrize("name", ["raft3", "raft5"])
+def test_fp_equality_matches_oracle_canon(name):
+    # fp equality <=> oracle canonical-view equality on a reachable sample
+    model, oracle, states, vecs = states_of(name, depth=4, cap=200)
+    auto, _ = canon_pair(model)
+    fps = np.asarray(auto.fingerprints(vecs)).tolist()
+    keys = [oracle.canon(st) for st in states]
+    by_key, by_fp = {}, {}
+    for fp, key in zip(fps, keys):
+        assert by_key.setdefault(key, fp) == fp, "same view, different fp"
+        assert by_fp.setdefault(fp, key) == key, "fp collision between views"
+
+
+def test_bag_multiset_hash_slot_order_free():
+    # two encodings of the same bag in different slot order must hash
+    # identically (the v3 bag hash is a multiset hash, no slot sort)
+    model, _oracle = raft3()
+    auto, _ = canon_pair(model)
+    vec = np.asarray(model.init_states()[0:1]).copy()
+    # synthesize: swap two occupied message slots if present; Init has an
+    # empty bag, so craft one state with two sends via the oracle
+    _model, oracle2 = raft3()
+    st = oracle2.init_state()
+    for _lab, s2 in oracle2.successors(st):
+        if len(s2["messages"]) >= 2:
+            st = s2
+            break
+    else:  # walk two steps to get >=2 distinct records
+        for _lab, s2 in oracle2.successors(st):
+            for _lab2, s3 in oracle2.successors(s2):
+                if len(s3["messages"]) >= 2:
+                    st = s3
+                    break
+            if len(st["messages"]) >= 2:
+                break
+    assert len(st["messages"]) >= 2
+    vec = model.encode(st)[None, :]
+    # swap the first two occupied slots across all bag words + cnt
+    lay = model.layout
+    sls = [lay.sl(f.name) for f in lay.fields.values()
+           if f.kind in ("msg_hi", "msg_lo", "msg_word", "msg_cnt")]
+    swapped = vec.copy()
+    for sl in sls:
+        seg = swapped[:, sl].copy()
+        seg[:, [0, 1]] = seg[:, [1, 0]]
+        swapped[:, sl] = seg
+    f1 = np.asarray(auto.fingerprints(vec))
+    f2 = np.asarray(auto.fingerprints(swapped))
+    assert np.array_equal(f1, f2)
+
+
+def test_seeded_family_differs():
+    # the audit relies on seeded families failing independently: same
+    # states, different seed => (near-certainly) different fingerprints
+    model, _oracle, _states, vecs = states_of("raft3")
+    a0 = Canonicalizer.for_model(model, symmetry=True, seed=0)
+    a1 = Canonicalizer.for_model(model, symmetry=True, seed=0x5EED)
+    f0 = np.asarray(a0.fingerprints(vecs))
+    f1 = np.asarray(a1.fingerprints(vecs))
+    assert not np.array_equal(f0, f1)
+    # but both must induce the SAME partition (orbit separation)
+    assert (len(set(f0.tolist())) == len(set(f1.tolist())))
