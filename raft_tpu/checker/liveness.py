@@ -43,6 +43,7 @@ import time
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.hashing import hash_lanes
@@ -74,7 +75,7 @@ class LivenessChecker:
     on the host."""
 
     def __init__(self, model, properties: tuple[str, ...], chunk: int = 512,
-                 max_states: int = 2_000_000):
+                 max_states: int = 8_000_000):
         self.model = model
         self.properties = tuple(properties)
         self.chunk = chunk
@@ -94,7 +95,7 @@ class LivenessChecker:
         # Collision budget: graph dedup uses one 64-bit hash family, so a
         # fingerprint collision would silently merge two states and could
         # mask a temporal violation (expected collisions ~ n^2/2^65; at
-        # the 2M-state cap that is ~1e-7). Run run(audit_seed=k) to
+        # the 8M-state default cap that is ~2e-6). Run run(audit_seed=k) to
         # re-explore under a second seeded family and cross-check
         # state/edge counts — a mismatch proves a collision in one family.
         self._fps = jax.jit(lambda v: hash_lanes(v))
@@ -102,78 +103,149 @@ class LivenessChecker:
     # ---------------- graph construction ----------------
 
     def _explore(self):
+        """Full-graph build, vectorized end-to-end (round-4 verdict
+        Next #7 — the per-unique-fingerprint python dict loop previously
+        capped practical graphs well under the host's memory):
+
+          - dedup = numpy searchsorted against a sorted (fp, gid) table,
+          - device pass A per chunk returns only fingerprints + validity
+            (u64/bool lanes — no [B, A, W] state transfer),
+          - device pass B re-expands just the chunks that discovered new
+            states and gathers exactly those successor vectors.
+        """
         model = self.model
         B, W, A = self.chunk, self.model.layout.W, self.model.A
-        expand = model.expand
         fps_fn = self._fps
+        if getattr(self, "_exp_fps_j", None) is None:
+            def _exp_fps(batch):
+                succs, valid, _rank, ovf = model.expand(batch)
+                flat = succs.reshape(-1, W)
+                return fps_fn(flat), valid.reshape(-1), jnp.any(valid & ovf)
+
+            def _exp_sel(batch, lanes):
+                succs, _v, _r, _o = model.expand(batch)
+                return succs.reshape(-1, W)[lanes]
+
+            self._exp_fps_j = jax.jit(_exp_fps)
+            self._exp_sel_j = jax.jit(_exp_sel)
 
         init = np.asarray(model.init_states())
         fp0 = np.asarray(jax.device_get(fps_fn(init)), dtype=np.uint64)
-        gid_of: dict[int, int] = {}
-        states: list[np.ndarray] = []
-        for k in range(len(init)):
-            if int(fp0[k]) not in gid_of:
-                gid_of[int(fp0[k])] = len(states)
-                states.append(init[k])
-        frontier = list(range(len(states)))
-        edges_src: list[np.ndarray] = []
-        edges_dst: list[np.ndarray] = []
-        edges_cand: list[np.ndarray] = []
+        _uq, first = np.unique(fp0, return_index=True)
+        first.sort()
+        init_d = init[first]  # first-occurrence order = gid order
+        n = len(init_d)
+        state_blocks: list[np.ndarray] = [init_d]
+        order0 = np.argsort(fp0[first], kind="stable")
+        sorted_fps = fp0[first][order0]
+        sorted_gids = order0.astype(np.int64)
+        frontier = init_d
+        frontier_gids = np.arange(n, dtype=np.int64)
+        esrc_l: list[np.ndarray] = []
+        edst_l: list[np.ndarray] = []
+        ecand_l: list[np.ndarray] = []
 
-        while frontier:
-            nxt: list[int] = []
+        while len(frontier):
+            # ---- pass A: fingerprints + validity only ----
+            chunk_batches: list[np.ndarray] = []
+            chunk_vidx: list[np.ndarray] = []
+            wave_srcs: list[np.ndarray] = []
+            wave_fps: list[np.ndarray] = []
             for off in range(0, len(frontier), B):
-                gids = frontier[off : off + B]
-                batch = np.stack([states[g] for g in gids])
+                batch = frontier[off : off + B]
                 nb = len(batch)
                 if nb < B:
                     batch = np.concatenate(
                         [batch, np.repeat(batch[-1:], B - nb, axis=0)]
                     )
-                succs, valid, _rank, ovf = jax.device_get(expand(batch))
-                valid = np.array(valid)  # writable copy
-                valid[nb:] = False
-                if np.any(valid & np.asarray(ovf)):
-                    raise OverflowError("message-slot overflow during liveness graph build")
-                flat = np.asarray(succs).reshape(B * A, W)
-                fps = np.asarray(
-                    jax.device_get(fps_fn(flat)), dtype=np.uint64
+                fps_c, valid_c, ovf_c = jax.device_get(
+                    self._exp_fps_j(jnp.asarray(batch))
                 )
-                vidx = np.nonzero(valid.reshape(-1))[0]
-                if len(vidx) == 0:
-                    continue
-                vfps = fps[vidx]
-                # dict work only per UNIQUE fingerprint in the batch; edge
-                # arrays are built vectorized (the per-edge python loop
-                # dominated graph construction on big configs)
-                uniq, first_idx, inv = np.unique(
-                    vfps, return_index=True, return_inverse=True
-                )
-                gid_map = np.empty(len(uniq), np.int64)
-                for u_i in range(len(uniq)):
-                    fp = int(uniq[u_i])
-                    g2 = gid_of.get(fp)
-                    if g2 is None:
-                        g2 = len(states)
-                        if g2 >= self.max_states:
-                            raise OverflowError(
-                                "liveness graph exceeds max_states; use a "
-                                "smaller config (liveness needs the full graph)"
-                            )
-                        gid_of[fp] = g2
-                        states.append(flat[vidx[first_idx[u_i]]].copy())
-                        nxt.append(g2)
-                    gid_map[u_i] = g2
-                gids_arr = np.asarray(gids, np.int64)
-                edges_src.append(gids_arr[vidx // A])
-                edges_dst.append(gid_map[inv])
-                edges_cand.append((vidx % A).astype(np.int32))
-            frontier = nxt
+                valid_c = np.asarray(valid_c).copy()
+                valid_c[nb * A:] = False
+                if bool(np.asarray(ovf_c)):
+                    raise OverflowError(
+                        "message-slot overflow during liveness graph build"
+                    )
+                vidx = np.nonzero(valid_c)[0]
+                chunk_batches.append(batch)
+                chunk_vidx.append(vidx)
+                wave_srcs.append(frontier_gids[off + vidx // A])
+                wave_fps.append(np.asarray(fps_c, dtype=np.uint64)[vidx])
+            if not chunk_vidx:
+                break
+            srcs = np.concatenate(wave_srcs)
+            cands = np.concatenate(
+                [(v % A).astype(np.int32) for v in chunk_vidx]
+            )
+            fps_w = np.concatenate(wave_fps)
+            if len(fps_w) == 0:
+                break
 
-        self._states = np.stack(states)
-        self._esrc = np.concatenate(edges_src) if edges_src else np.zeros(0, np.int64)
-        self._edst = np.concatenate(edges_dst) if edges_dst else np.zeros(0, np.int64)
-        self._ecand = np.concatenate(edges_cand) if edges_cand else np.zeros(0, np.int32)
+            # ---- resolve against the global table ----
+            pos = np.searchsorted(sorted_fps, fps_w)
+            pos = np.clip(pos, 0, max(0, len(sorted_fps) - 1))
+            hit = (
+                (sorted_fps[pos] == fps_w)
+                if len(sorted_fps) else np.zeros(len(fps_w), bool)
+            )
+            gid_w = np.where(hit, sorted_gids[pos], -1)
+            nf_mask = ~hit
+            new_states = np.zeros((0, W), np.int32)
+            if nf_mask.any():
+                nf = fps_w[nf_mask]
+                uq, first_u = np.unique(nf, return_index=True)
+                disc = np.argsort(first_u, kind="stable")  # discovery order
+                new_count = len(uq)
+                if n + new_count > self.max_states:
+                    raise OverflowError(
+                        "liveness graph exceeds max_states; raise it or "
+                        "use a smaller config (liveness needs the full graph)"
+                    )
+                uq_gids = np.empty(new_count, np.int64)
+                uq_gids[disc] = n + np.arange(new_count)
+                gid_w[nf_mask] = uq_gids[np.searchsorted(uq, nf)]
+
+                # ---- pass B: fetch exactly the new states' vectors.
+                # lanes are padded to power-of-two buckets so jit compiles
+                # a handful of shapes, not one per distinct new-count
+                # (the remote-compile service costs ~20 s per shape)
+                nf_wave_lane = np.nonzero(nf_mask)[0][first_u]  # per uq
+                new_states = np.empty((new_count, W), np.int32)
+                bounds = np.cumsum([0] + [len(v) for v in chunk_vidx])
+                ci = np.searchsorted(bounds, nf_wave_lane, side="right") - 1
+                for c in np.unique(ci):
+                    sel = np.nonzero(ci == c)[0]  # uq indices in chunk c
+                    lanes = chunk_vidx[c][nf_wave_lane[sel] - bounds[c]]
+                    k = len(lanes)
+                    bucket = 1 << max(5, (k - 1).bit_length())
+                    lanes_p = np.zeros(bucket, lanes.dtype)
+                    lanes_p[:k] = lanes
+                    vecs = np.asarray(jax.device_get(
+                        self._exp_sel_j(
+                            jnp.asarray(chunk_batches[c]),
+                            jnp.asarray(lanes_p),
+                        )
+                    ))[:k]
+                    new_states[uq_gids[sel] - n] = vecs
+
+                state_blocks.append(new_states)
+                frontier_gids = n + np.arange(new_count, dtype=np.int64)
+                n += new_count
+                merged_fps = np.concatenate([sorted_fps, uq])
+                merged_gids = np.concatenate([sorted_gids, uq_gids])
+                order2 = np.argsort(merged_fps, kind="stable")
+                sorted_fps = merged_fps[order2]
+                sorted_gids = merged_gids[order2]
+            esrc_l.append(srcs)
+            edst_l.append(gid_w)
+            ecand_l.append(cands)
+            frontier = new_states
+
+        self._states = np.concatenate(state_blocks, axis=0)
+        self._esrc = np.concatenate(esrc_l) if esrc_l else np.zeros(0, np.int64)
+        self._edst = np.concatenate(edst_l) if edst_l else np.zeros(0, np.int64)
+        self._ecand = np.concatenate(ecand_l) if ecand_l else np.zeros(0, np.int32)
         self._n_init = len(init)
 
     def _eval_kernel(self, fn) -> np.ndarray:
@@ -205,42 +277,25 @@ class LivenessChecker:
             )
         return self._fwd
 
-    def _rev_adj(self):
-        """CSR reverse adjacency (src-by-dst, row starts)."""
-        if getattr(self, "_rev", None) is None:
-            n = len(self._states)
-            order = np.argsort(self._edst, kind="stable")
-            self._rev = (
-                self._esrc[order],
-                np.searchsorted(self._edst[order], np.arange(n + 1)),
-            )
-        return self._rev
-
     def _sustain_set(self, notq: np.ndarray) -> np.ndarray:
         """Largest S subset of ~Q with: member is terminal (no successors at
-        all) or has a successor in S. Peeling from the exit count."""
+        all) or has a successor in S. Vectorized peel: each round drops
+        every non-terminal member with zero exits into S (numpy bincount
+        over the live edges; rounds are bounded by the longest removal
+        chain, and each round is O(E) in C — the python per-node queue
+        this replaces was the liveness bottleneck on big graphs)."""
         n = len(notq)
         esrc, edst = self._esrc, self._edst
-        # exit_count[s] = #edges s->t with t in S (init: t in ~Q)
         in_s = notq.copy()
-        live_edge = in_s[edst]
-        exit_count = np.bincount(esrc[live_edge], minlength=n)
         out_deg = np.bincount(esrc, minlength=n)
         terminal = out_deg == 0
-        work = list(np.nonzero(in_s & ~terminal & (exit_count == 0))[0])
-        rsorted_src, rstart = self._rev_adj()
-        while work:
-            t = work.pop()
-            if not in_s[t]:
-                continue
-            in_s[t] = False
-            for k in range(rstart[t], rstart[t + 1]):
-                s = rsorted_src[k]
-                if in_s[s] and not terminal[s]:
-                    exit_count[s] -= 1
-                    if exit_count[s] == 0:
-                        work.append(s)
-        return in_s
+        while True:
+            live_edge = in_s[edst] & in_s[esrc]
+            exit_count = np.bincount(esrc[live_edge], minlength=n)
+            drop = in_s & ~terminal & (exit_count == 0)
+            if not drop.any():
+                return in_s
+            in_s &= ~drop
 
     def _shortest_path(self, from_set: np.ndarray, to_set: np.ndarray):
         """BFS (by gid) from any node in from_set to any node in to_set;
@@ -314,9 +369,9 @@ class LivenessChecker:
             base = (n, len(self._esrc))
             saved = (self._fps, self._states, self._esrc, self._edst,
                      self._ecand, self._n_init, getattr(self, "_fwd", None),
-                     getattr(self, "_rev", None))
+                     getattr(self, "_exp_fps_j", None))
             self._fps = jax.jit(lambda v: hash_lanes(v, seed=audit_seed))
-            self._fwd = self._rev = None
+            self._fwd = self._exp_fps_j = None  # rebuild on the new family
             try:
                 try:
                     self._explore()
@@ -334,7 +389,8 @@ class LivenessChecker:
                 other = (len(self._states), len(self._esrc))
             finally:
                 (self._fps, self._states, self._esrc, self._edst,
-                 self._ecand, self._n_init, self._fwd, self._rev) = saved
+                 self._ecand, self._n_init, self._fwd,
+                 self._exp_fps_j) = saved
             if other != base:
                 raise RuntimeError(
                     f"liveness graph collision audit FAILED: primary family "

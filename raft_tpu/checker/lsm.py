@@ -202,9 +202,27 @@ class RunLSM:
         self.occ[lv] = True
 
     def export_host(self) -> list[np.ndarray]:
-        """Occupied runs fetched to host (engine filters/sorts them)."""
+        """Occupied runs fetched to host (raw, sentinel-padded)."""
         return [
             np.asarray(jax.device_get(self.runs[i]))
             for i in range(len(self.runs))
             if self.occ[i]
         ]
+
+    def export_real(self):
+        """Real fingerprints, sentinel-filtered and sorted: a flat [n]
+        array for lead_shape (), a list of per-row arrays for (D,)
+        (the checkpoint format both engines share)."""
+        parts = self.export_host()
+        sent = np.uint64(U64_MAX)
+
+        def pack(arrs):
+            cat = (np.concatenate(arrs) if arrs
+                   else np.empty(0, np.uint64))
+            cat = cat[cat != sent]
+            cat.sort()
+            return cat
+
+        if not self._lead:
+            return pack(parts)
+        return [pack([p[d] for p in parts]) for d in range(self._lead[0])]

@@ -231,7 +231,7 @@ def profile_stages(
         stats = jnp.zeros((5,), jnp.int64)
         args = [frontier_d, nb, jp, jc, viol, stats,
                 np.int32(0), np.int32(min(fcount, C)), np.int32(0),
-                occ_dev, *runs]
+                occ_dev, jnp.asarray(True), *runs]
         jax.block_until_ready(args)
         t0 = time.perf_counter()
         r = dev._chunk_fn(*args)
@@ -241,11 +241,11 @@ def profile_stages(
     fused_once()  # compile
     st["fused_chunk"] = float(np.median([fused_once() for _ in range(reps)]))
 
-    chunk_sum = sum(
-        st[k] for k in
-        ("expand", "compact", "canon", "probe", "run_emit", "scatter",
-         "invariants")
-    ) - 7 * null  # each stage row pays one dispatch
+    timed = ["expand", "compact", "canon", "probe", "run_emit", "scatter"]
+    if invariants:
+        timed.append("invariants")
+    # each TIMED stage row pays one dispatch floor
+    chunk_sum = sum(st[k] for k in timed) - len(timed) * null
     n_chunks = max(1, (fcount + C - 1) // C)
     per_chunk = st["fused_chunk"] + amortized
     out["per_wave_s"] = {

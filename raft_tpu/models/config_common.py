@@ -25,14 +25,32 @@ receipt kernel — stays in the variant modules.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import bag
+from ..ops.packing import EMPTY
 
 # enums shared by both variants (identical values in both specs' lowerings)
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
 NIL = 0
 ACK_NIL, ACK_FALSE, ACK_TRUE = 0, 1, 2
 RVREQ, RVRESP, AEREQ, AERESP, SNAPREQ, SNAPRESP = 1, 2, 3, 4, 5, 6
+MTYPE_NAMES = {
+    RVREQ: "RequestVoteRequest",
+    RVRESP: "RequestVoteResponse",
+    AEREQ: "AppendEntriesRequest",
+    AERESP: "AppendEntriesResponse",
+    SNAPREQ: "SnapshotRequest",
+    SNAPRESP: "SnapshotResponse",
+}
+# AppendEntries result codes (AddRemove :75; Ok=1 so 0 = "field absent")
+RC_OK, RC_STALE, RC_MISMATCH, RC_NEEDSNAP = 1, 2, 3, 4
+RC_NAMES = {
+    RC_OK: "Ok",
+    RC_STALE: "StaleTerm",
+    RC_MISMATCH: "EntryMismatch",
+    RC_NEEDSNAP: "NeedSnapshot",
+}
 PENDING_SNAP_REQUEST = -1  # JointConsensus :293 / AddRemove :271
 PENDING_SNAP_RESPONSE = -2
 
@@ -318,3 +336,371 @@ class ConfigRaftCommon:
             axis=(1, 2),
         )
         return ~bad
+
+    # ---------------- shared fused receipt kernel ----------------
+    #
+    # Both reconfig specs receive the same eight message-triggered
+    # actions with identical guards and effects — the ONLY variant
+    # deltas are which log commands carry a configuration and what a
+    # configuration install writes, so those are the two hooks.
+
+    def _is_cfg_cmd(self, cmd):
+        """Mask of log-entry command values that carry a configuration
+        (JointConsensus: OldNewConfig/NewConfig; AddRemove: Init/Add/
+        Remove). Variant hook."""
+        raise NotImplementedError
+
+    def _config_updates_from_log(self, d, dst, logs, cfg_pos, cfg_idx, mci):
+        """(updates dict for the config_* layout fields, in_new bool)
+        after installing the most recent config entry of `logs` at
+        `cfg_pos` on server `dst` (commit watermark `mci`). Variant
+        hook — the two specs cache different config projections."""
+        raise NotImplementedError
+
+    def _handle_message(self, s, m):
+        """The fused receipt kernel: UpdateTerm, Handle{RequestVote,
+        AppendEntries,Snapshot}{Request,Response} and Reject/Accept
+        AppendEntries for bag slot m — JointConsensus :410-:944 /
+        AddRemove :404-:921 (identical structure; the reference
+        copy-inlines this machinery between the two specs)."""
+        p = self.p
+        L = p.max_log
+        d = self._dec(s)
+        words, cnt = self._words(d), d["msg_cnt"]
+        key = [w[m] for w in words]
+        kcnt = cnt[m]
+        occupied = key[0] != EMPTY
+        u = lambda n: self.packer.unpack(key, n)  # noqa: E731
+        mtype, mterm = u("mtype"), u("mterm")
+        src, dst = u("msource"), u("mdest")
+        cur = d["currentTerm"][dst]
+        st_dst = d["state"][dst]
+        member_dst = ((d["config_members"][dst] >> dst) & 1) > 0
+        recv = occupied & (kcnt > 0)
+        le_term = mterm <= cur
+        eq_term = mterm == cur
+        cnt_disc = bag.bag_discard_at(cnt, m)
+
+        def reply(resp_key):
+            return self._bag_put(words, cnt_disc, resp_key)
+
+        # --- UpdateTerm (count may be 0)
+        b_upd = occupied & (mterm > cur)
+        s_upd = self._asm(
+            d,
+            currentTerm=d["currentTerm"].at[dst].set(mterm),
+            state=d["state"].at[dst].set(FOLLOWER),
+            votedFor=d["votedFor"].at[dst].set(NIL),
+        )
+
+        # --- HandleRequestVoteRequest
+        last_t = self._last_term(d, dst)
+        ll_dst = d["log_len"][dst]
+        rv_logok = (u("mlastLogTerm") > last_t) | (
+            (u("mlastLogTerm") == last_t) & (u("mlastLogIndex") >= ll_dst)
+        )
+        grant = (
+            eq_term
+            & rv_logok
+            & ((d["votedFor"][dst] == NIL) | (d["votedFor"][dst] == src + 1))
+        )
+        b_rvreq = recv & (mtype == RVREQ) & le_term
+        rv_key = self._pack(
+            mtype=RVRESP,
+            mterm=cur,
+            mvoteGranted=grant.astype(jnp.int32),
+            msource=dst,
+            mdest=src,
+        )
+        w1, c1, _ex1, ovf1 = reply(rv_key)
+        s_rvreq = self._asm(
+            d,
+            votedFor=jnp.where(
+                grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]
+            ),
+            **self._word_upd(w1, c1),
+        )
+
+        # --- HandleRequestVoteResponse
+        b_rvresp = recv & (mtype == RVRESP) & eq_term & (st_dst == CANDIDATE)
+        vg = jnp.where(
+            u("mvoteGranted") > 0,
+            d["votesGranted"].at[dst].set(
+                d["votesGranted"][dst] | (jnp.int32(1) << src)
+            ),
+            d["votesGranted"],
+        )
+        s_rvresp = self._asm(d, votesGranted=vg, msg_cnt=cnt_disc)
+
+        # --- AppendEntries request handling: LogOk (strict empty-entries
+        # arm, AddRemove :650-667 == JointConsensus) + result-code CASE
+        prev_idx = u("mprevLogIndex")
+        prev_term = u("mprevLogTerm")
+        nent = u("nentries")
+        lt_row = d["log_term"][dst]
+        at_prev = lt_row[jnp.clip(prev_idx - 1, 0, L - 1)]
+        ae_logok = jnp.where(
+            nent > 0,
+            (prev_idx > 0) & (prev_idx <= ll_dst) & (prev_term == at_prev),
+            (prev_idx == ll_dst) & (prev_idx > 0) & (prev_term == at_prev),
+        )
+        rc = jnp.where(
+            mterm < cur,
+            RC_STALE,
+            jnp.where(
+                ~member_dst,
+                RC_NEEDSNAP,
+                jnp.where(
+                    eq_term & (st_dst == FOLLOWER) & ~ae_logok, RC_MISMATCH, RC_OK
+                ),
+            ),
+        )
+
+        # RejectAppendEntriesRequest
+        b_reject = recv & (mtype == AEREQ) & le_term & (rc != RC_OK)
+        rj_key = self._pack(
+            mtype=AERESP,
+            mterm=cur,
+            mresult=rc,
+            mmatchIndex=0,
+            msource=dst,
+            mdest=src,
+        )
+        w2, c2, _ex2, ovf2 = reply(rj_key)
+        s_reject = self._asm(d, **self._word_upd(w2, c2))
+
+        # AcceptAppendEntriesRequest
+        b_accept = (
+            recv
+            & (mtype == AEREQ)
+            & eq_term
+            & ((st_dst == FOLLOWER) | (st_dst == CANDIDATE))
+            & ae_logok
+            & member_dst
+        )
+        can_append = (nent != 0) & (ll_dst == prev_idx)
+        needs_trunc = (nent != 0) & (ll_dst >= prev_idx + 1)
+        appending = can_append | needs_trunc
+        new_ll = jnp.where(appending, prev_idx + 1, ll_dst)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        keep = lanes < prev_idx
+        app_pos = jnp.clip(prev_idx, 0, L - 1)
+        new_logs = {}
+        for n in self.ENTRY_FIELDS:
+            row = d[f"log_{n}"][dst]
+            nrow = jnp.where(keep, row, 0).at[app_pos].set(
+                jnp.where(appending, u(f"e_{n}"), 0)
+            )
+            new_logs[n] = jnp.where(appending, nrow, row)
+        cfg_mask = (lanes < new_ll) & self._is_cfg_cmd(new_logs["cmd"])
+        cfg_idx = jnp.max(jnp.where(cfg_mask, lanes + 1, 0))
+        cfg_pos = jnp.clip(cfg_idx - 1, 0)
+        mci = u("mcommitIndex")
+        cfg_upd, in_new = self._config_updates_from_log(
+            d, dst, new_logs, cfg_pos, cfg_idx, mci
+        )
+        ac_ovf = b_accept & appending & (prev_idx >= L)
+        ac_key = self._pack(
+            mtype=AERESP,
+            mterm=cur,
+            mresult=RC_OK,
+            mmatchIndex=prev_idx + nent,
+            msource=dst,
+            mdest=src,
+        )
+        w3, c3, _ex3, ovf3 = reply(ac_key)
+        upd3 = dict(
+            commitIndex=d["commitIndex"].at[dst].set(mci),
+            state=d["state"].at[dst].set(jnp.where(in_new, FOLLOWER, NOTMEMBER)),
+            log_len=d["log_len"].at[dst].set(new_ll),
+            **cfg_upd,
+            **self._word_upd(w3, c3),
+        )
+        for n in self.ENTRY_FIELDS:
+            upd3[f"log_{n}"] = d[f"log_{n}"].at[dst].set(new_logs[n])
+        s_accept = self._asm(d, **upd3)
+
+        # --- HandleAppendEntriesResponse
+        b_aeresp = recv & (mtype == AERESP) & eq_term & (st_dst == LEADER)
+        res = u("mresult")
+        mmatch = u("mmatchIndex")
+        ni_cur = d["nextIndex"][dst, src]
+        ni_new = jnp.where(
+            res == RC_OK,
+            mmatch + 1,
+            jnp.where(
+                res == RC_MISMATCH,
+                jnp.maximum(ni_cur - 1, 1),
+                jnp.where(res == RC_NEEDSNAP, PENDING_SNAP_REQUEST, ni_cur),
+            ),
+        )
+        mi_new = jnp.where(
+            res == RC_OK, d["matchIndex"].at[dst, src].set(mmatch), d["matchIndex"]
+        )
+        s_aeresp = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[dst, src].set(ni_new),
+            matchIndex=mi_new,
+            pendingResponse=d["pendingResponse"].at[dst].set(
+                d["pendingResponse"][dst] & ~(jnp.int32(1) << src)
+            ),
+            msg_cnt=cnt_disc,
+        )
+
+        # --- HandleSnapshotRequest
+        b_snapreq = recv & (mtype == SNAPREQ) & eq_term & (st_dst == FOLLOWER)
+        sn_ll = u("mloglen")
+        sn_logs = {
+            n: jnp.stack([u(f"l{k}_{n}") for k in range(L)])
+            for n in self.ENTRY_FIELDS
+        }
+        sn_mask = (lanes < sn_ll) & self._is_cfg_cmd(sn_logs["cmd"])
+        sn_idx = jnp.max(jnp.where(sn_mask, lanes + 1, 0))
+        sn_pos = jnp.clip(sn_idx - 1, 0)
+        sn_mci = u("mcommitIndex")
+        sn_cfg_upd, _sn_in_new = self._config_updates_from_log(
+            d, dst, sn_logs, sn_pos, sn_idx, sn_mci
+        )
+        sq_key = self._pack(
+            mtype=SNAPRESP,
+            mterm=cur,
+            msuccess=1,
+            mmatchIndex=sn_ll,
+            msource=dst,
+            mdest=src,
+        )
+        w4, c4, _ex4, ovf4 = reply(sq_key)
+        upd4 = dict(
+            commitIndex=d["commitIndex"].at[dst].set(sn_mci),
+            log_len=d["log_len"].at[dst].set(sn_ll),
+            **sn_cfg_upd,
+            **self._word_upd(w4, c4),
+        )
+        for n in self.ENTRY_FIELDS:
+            upd4[f"log_{n}"] = d[f"log_{n}"].at[dst].set(sn_logs[n])
+        s_snapreq = self._asm(d, **upd4)
+
+        # --- HandleSnapshotResponse
+        b_snapresp = (
+            recv
+            & (mtype == SNAPRESP)
+            & eq_term
+            & (d["nextIndex"][dst, src] == PENDING_SNAP_RESPONSE)
+        )
+        s_snapresp = self._asm(
+            d,
+            nextIndex=d["nextIndex"].at[dst, src].set(u("mmatchIndex") + 1),
+            matchIndex=d["matchIndex"].at[dst, src].set(u("mmatchIndex")),
+            msg_cnt=cnt_disc,
+        )
+
+        branches = [
+            (b_upd, s_upd, R_UPDATETERM, jnp.asarray(False)),
+            (b_rvreq, s_rvreq, R_HANDLE_RVREQ, ovf1),
+            (b_rvresp, s_rvresp, R_HANDLE_RVRESP, jnp.asarray(False)),
+            (b_reject, s_reject, R_REJECT_AE, ovf2),
+            (b_accept, s_accept, R_ACCEPT_AE, ovf3 | ac_ovf),
+            (b_aeresp, s_aeresp, R_HANDLE_AERESP, jnp.asarray(False)),
+            (b_snapreq, s_snapreq, R_HANDLE_SNAPREQ, ovf4),
+            (b_snapresp, s_snapresp, R_HANDLE_SNAPRESP, jnp.asarray(False)),
+        ]
+        valid = jnp.asarray(False)
+        succ = s
+        rank = jnp.int32(-1)
+        ovf = jnp.asarray(False)
+        for b, sb, rk, ob in branches:
+            valid = valid | b
+            succ = jnp.where(b, sb, succ)
+            rank = jnp.where(b, jnp.int32(rk), rank)
+            ovf = ovf | (b & ob)
+        return valid, succ, rank, ovf
+
+    def init_states(self) -> np.ndarray:
+        """Init — :341-354: pre-installed cluster seeded with a
+        NewConfigCommand; CHOOSE realized as lowest indices."""
+        p = self.p
+        S = p.n_servers
+        lay = self.layout
+        vec = lay.zeros((1,))
+        members = list(range(p.init_cluster_size))
+        mask = sum(1 << i for i in members)
+        leader = 0
+        vec[0, lay.sl("config_id")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("config_members")] = [
+            mask if i in members else 0 for i in range(S)
+        ]
+        vec[0, lay.sl("config_committed")] = [
+            1 if i in members else 0 for i in range(S)
+        ]
+        vec[0, lay.sl("currentTerm")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("state")] = [
+            LEADER if i == leader else FOLLOWER if i in members else NOTMEMBER
+            for i in range(S)
+        ]
+        ni = np.ones((S, S), np.int32)
+        mi = np.zeros((S, S), np.int32)
+        for j in members:
+            ni[leader, j] = 2
+            mi[leader, j] = 1
+        vec[0, lay.sl("nextIndex")] = ni.reshape(-1)
+        vec[0, lay.sl("matchIndex")] = mi.reshape(-1)
+        lt = np.zeros((S, p.max_log), np.int32)
+        lc = np.zeros((S, p.max_log), np.int32)
+        lcid = np.zeros((S, p.max_log), np.int32)
+        lcm = np.zeros((S, p.max_log), np.int32)
+        for i in members:
+            lt[i, 0] = 1
+            lc[i, 0] = self.CMD_SEED
+            lcid[i, 0] = 1
+            lcm[i, 0] = mask
+        vec[0, lay.sl("log_term")] = lt.reshape(-1)
+        vec[0, lay.sl("log_cmd")] = lc.reshape(-1)
+        vec[0, lay.sl("log_cid")] = lcid.reshape(-1)
+        vec[0, lay.sl(f"log_{self.MEMBERS_FIELD}")] = lcm.reshape(-1)
+        vec[0, lay.sl("log_len")] = [1 if i in members else 0 for i in range(S)]
+        vec[0, lay.sl("commitIndex")] = [1 if i in members else 0 for i in range(S)]
+        for k in range(self.n_words):
+            vec[0, lay.sl(f"msg_w{k}")] = int(EMPTY)
+        vec[0, lay.sl("acked")] = ACK_NIL
+        return vec
+
+    # ---------------- invariants ----------------
+
+    def encode_msg(self, rec: tuple) -> tuple:
+        d = dict(rec)
+        mtype = {v: k for k, v in MTYPE_NAMES.items()}[d["mtype"]]
+        kw = dict(
+            mtype=mtype, mterm=d["mterm"], msource=d["msource"], mdest=d["mdest"]
+        )
+        if mtype == RVREQ:
+            kw.update(
+                mlastLogTerm=d["mlastLogTerm"], mlastLogIndex=d["mlastLogIndex"]
+            )
+        elif mtype == RVRESP:
+            kw.update(mvoteGranted=int(d["mvoteGranted"]))
+        elif mtype == AEREQ:
+            kw.update(
+                mprevLogIndex=d["mprevLogIndex"],
+                mprevLogTerm=d["mprevLogTerm"],
+                nentries=len(d["mentries"]),
+                mcommitIndex=d["mcommitIndex"],
+            )
+            if d["mentries"]:
+                kw.update(
+                    {f"e_{n}": v for n, v in self._encode_entry(d["mentries"][0]).items()}
+                )
+        elif mtype == AERESP:
+            inv_rc = {v: k for k, v in RC_NAMES.items()}
+            kw.update(mresult=inv_rc[d["mresult"]], mmatchIndex=d["mmatchIndex"])
+        elif mtype == SNAPREQ:
+            kw.update(
+                mloglen=len(d["mlog"]),
+                mcommitIndex=d["mcommitIndex"],
+                mmembers=sum(1 << j for j in d["mmembers"]),
+            )
+            for k, e in enumerate(d["mlog"]):
+                kw.update({f"l{k}_{n}": v for n, v in self._encode_entry(e).items()})
+        elif mtype == SNAPRESP:
+            kw.update(msuccess=int(d["msuccess"]), mmatchIndex=d["mmatchIndex"])
+        return self.packer.pack(**kw)
+

@@ -57,22 +57,8 @@ CMD_NAMES = {
 }
 
 # mtype (:78-80)
-MTYPE_NAMES = {
-    RVREQ: "RequestVoteRequest",
-    RVRESP: "RequestVoteResponse",
-    AEREQ: "AppendEntriesRequest",
-    AERESP: "AppendEntriesResponse",
-    SNAPREQ: "SnapshotRequest",
-    SNAPRESP: "SnapshotResponse",
-}
 # AppendEntries result codes (:75); Ok=1 so 0 means "field absent"
 RC_OK, RC_STALE, RC_MISMATCH, RC_NEEDSNAP = 1, 2, 3, 4
-RC_NAMES = {
-    RC_OK: "Ok",
-    RC_STALE: "StaleTerm",
-    RC_MISMATCH: "EntryMismatch",
-    RC_NEEDSNAP: "NeedSnapshot",
-}
 
 
 # Next-disjunct ranks (:943-965), for trace labels.
@@ -101,11 +87,21 @@ ENTRY_FIELDS = ("term", "cmd", "val", "cid", "cmem", "cmembers")
 
 from .config_common import (
     ConfigRaftCommon,
+    MTYPE_NAMES,
+    RC_NAMES,
+    R_ACCEPT_AE as _R_AC,
     R_APPENDENTRIES as _R_AE,
     R_CLIENTREQUEST as _R_CR,
+    R_HANDLE_AERESP as _R_HA,
+    R_HANDLE_RVREQ as _R_HQ,
+    R_HANDLE_RVRESP as _R_HP,
+    R_HANDLE_SNAPREQ as _R_SQ,
+    R_HANDLE_SNAPRESP as _R_SP,
+    R_REJECT_AE as _R_RJ,
     R_REQUESTVOTE as _R_RV,
     R_RESTART as _R_RS,
     R_SENDSNAP as _R_SS,
+    R_UPDATETERM as _R_UT,
 )
 
 # the mixin's kernels emit the shared rank constants; both variants lay
@@ -113,6 +109,10 @@ from .config_common import (
 assert (A_RESTART, A_REQUESTVOTE, A_CLIENTREQUEST,
         A_APPENDENTRIES, A_SENDSNAP) == (
     _R_RS, _R_RV, _R_CR, _R_AE, _R_SS)
+assert (A_UPDATETERM, A_HANDLE_RVREQ, A_HANDLE_RVRESP,
+        A_REJECT_AE, A_ACCEPT_AE, A_HANDLE_AERESP,
+        A_HANDLE_SNAPREQ, A_HANDLE_SNAPRESP) == (
+    _R_UT, _R_HQ, _R_HP, _R_RJ, _R_AC, _R_HA, _R_SQ, _R_SP)
 
 ACTION_NAMES = [
     "Restart",
@@ -255,6 +255,8 @@ class ReconfigRaftModel(ConfigRaftCommon):
 
     name = "RaftWithReconfigAddRemove"
     ENTRY_FIELDS = ENTRY_FIELDS
+    CMD_SEED = CMD_INIT  # Init's seeded first entry (:324-338)
+    MEMBERS_FIELD = "cmembers"
     CMD_APPEND = CMD_APPEND
     ACTION_NAMES = ACTION_NAMES
 
@@ -568,287 +570,25 @@ class ReconfigRaftModel(ConfigRaftCommon):
 
     # -------- fused message-receipt kernel (slot m) --------
 
-    def _handle_message(self, s, m):
-        p = self.p
-        S, L = p.n_servers, p.max_log
-        d = self._dec(s)
-        words, cnt = self._words(d), d["msg_cnt"]
-        key = [w[m] for w in words]
-        kcnt = cnt[m]
-        occupied = key[0] != EMPTY
-        u = lambda n: self.packer.unpack(key, n)
-        mtype, mterm = u("mtype"), u("mterm")
-        src, dst = u("msource"), u("mdest")
-        cur = d["currentTerm"][dst]
-        st_dst = d["state"][dst]
-        member_dst = ((d["config_members"][dst] >> dst) & 1) > 0
-        recv = occupied & (kcnt > 0)
-        le_term = mterm <= cur
-        eq_term = mterm == cur
-        cnt_disc = bag.bag_discard_at(cnt, m)
+    def _is_cfg_cmd(self, cmd):
+        """InitCluster / AddServer / RemoveServer entries carry a
+        configuration (:66-69); hook for the shared receipt kernel."""
+        return (cmd == CMD_INIT) | (cmd == CMD_ADD) | (cmd == CMD_REMOVE)
 
-        def reply(resp_key):
-            return self._bag_put(words, cnt_disc, resp_key)
-
-        # --- UpdateTerm (:404-413): count may be 0
-        b_upd = occupied & (mterm > cur)
-        s_upd = self._asm(
-            d,
-            currentTerm=d["currentTerm"].at[dst].set(mterm),
-            state=d["state"].at[dst].set(FOLLOWER),
-            votedFor=d["votedFor"].at[dst].set(NIL),
-        )
-
-        # --- HandleRequestVoteRequest (:449-472)
-        last_t = self._last_term(d, dst)
-        ll_dst = d["log_len"][dst]
-        rv_logok = (u("mlastLogTerm") > last_t) | (
-            (u("mlastLogTerm") == last_t) & (u("mlastLogIndex") >= ll_dst)
-        )
-        grant = (
-            eq_term
-            & rv_logok
-            & ((d["votedFor"][dst] == NIL) | (d["votedFor"][dst] == src + 1))
-        )
-        b_rvreq = recv & (mtype == RVREQ) & le_term
-        rv_key = self._pack(
-            mtype=RVRESP,
-            mterm=cur,
-            mvoteGranted=grant.astype(jnp.int32),
-            msource=dst,
-            mdest=src,
-        )
-        w1, c1, _ex1, ovf1 = reply(rv_key)
-        s_rvreq = self._asm(
-            d,
-            votedFor=jnp.where(
-                grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]
-            ),
-            **self._word_upd(w1, c1),
-        )
-
-        # --- HandleRequestVoteResponse (:477-493)
-        b_rvresp = recv & (mtype == RVRESP) & eq_term & (st_dst == CANDIDATE)
-        vg = jnp.where(
-            u("mvoteGranted") > 0,
-            d["votesGranted"].at[dst].set(
-                d["votesGranted"][dst] | (jnp.int32(1) << src)
-            ),
-            d["votesGranted"],
-        )
-        s_rvresp = self._asm(d, votesGranted=vg, msg_cnt=cnt_disc)
-
-        # --- AppendEntries request handling
-        prev_idx = u("mprevLogIndex")
-        prev_term = u("mprevLogTerm")
-        nent = u("nentries")
-        lt_row = d["log_term"][dst]
-        # LogOk (:650-667): strict empty-entries arm
-        at_prev = lt_row[jnp.clip(prev_idx - 1, 0, L - 1)]
-        ae_logok = jnp.where(
-            nent > 0,
-            (prev_idx > 0) & (prev_idx <= ll_dst) & (prev_term == at_prev),
-            (prev_idx == ll_dst) & (prev_idx > 0) & (prev_term == at_prev),
-        )
-        # result code CASE (:676-681)
-        rc = jnp.where(
-            mterm < cur,
-            RC_STALE,
-            jnp.where(
-                ~member_dst,
-                RC_NEEDSNAP,
-                jnp.where(
-                    eq_term & (st_dst == FOLLOWER) & ~ae_logok, RC_MISMATCH, RC_OK
-                ),
-            ),
-        )
-
-        # RejectAppendEntriesRequest (:669-693)
-        b_reject = recv & (mtype == AEREQ) & le_term & (rc != RC_OK)
-        rj_key = self._pack(
-            mtype=AERESP,
-            mterm=cur,
-            mresult=rc,
-            mmatchIndex=0,
-            msource=dst,
-            mdest=src,
-        )
-        w2, c2, _ex2, ovf2 = reply(rj_key)
-        s_reject = self._asm(d, **self._word_upd(w2, c2))
-
-        # AcceptAppendEntriesRequest (:716-753)
-        b_accept = (
-            recv
-            & (mtype == AEREQ)
-            & eq_term
-            & ((st_dst == FOLLOWER) | (st_dst == CANDIDATE))
-            & ae_logok
-            & member_dst
-        )
-        can_append = (nent != 0) & (ll_dst == prev_idx)  # CanAppend (:705-707)
-        needs_trunc = (nent != 0) & (ll_dst >= prev_idx + 1)  # (:709-711)
-        appending = can_append | needs_trunc
-        new_ll = jnp.where(appending, prev_idx + 1, ll_dst)
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        keep = lanes < prev_idx
-        app_pos = jnp.clip(prev_idx, 0, L - 1)
-        new_logs = {}
-        for n in ENTRY_FIELDS:
-            row = d[f"log_{n}"][dst]
-            nrow = jnp.where(keep, row, 0).at[app_pos].set(
-                jnp.where(appending, u(f"e_{n}"), 0)
-            )
-            new_logs[n] = jnp.where(appending, nrow, row)
-        # config from the new log (:734-739)
-        is_cfg = (
-            (new_logs["cmd"] == CMD_INIT)
-            | (new_logs["cmd"] == CMD_ADD)
-            | (new_logs["cmd"] == CMD_REMOVE)
-        )
-        cfg_mask = (lanes < new_ll) & is_cfg
-        cfg_idx = jnp.max(jnp.where(cfg_mask, lanes + 1, 0))
-        cfg_pos = jnp.clip(cfg_idx - 1, 0)
-        new_cid = new_logs["cid"][cfg_pos]
-        new_cmembers = new_logs["cmembers"][cfg_pos]
-        mci = u("mcommitIndex")
-        new_committed = (mci >= cfg_idx).astype(jnp.int32)
-        in_new = ((new_cmembers >> dst) & 1) > 0
-        ac_ovf = b_accept & appending & (prev_idx >= L)
-        ac_key = self._pack(
-            mtype=AERESP,
-            mterm=cur,
-            mresult=RC_OK,
-            mmatchIndex=prev_idx + nent,
-            msource=dst,
-            mdest=src,
-        )
-        w3, c3, _ex3, ovf3 = reply(ac_key)
-        s_accept = self._asm(
-            d,
-            config_id=d["config_id"].at[dst].set(new_cid),
-            config_members=d["config_members"].at[dst].set(new_cmembers),
-            config_committed=d["config_committed"].at[dst].set(new_committed),
-            commitIndex=d["commitIndex"].at[dst].set(mci),
-            state=d["state"].at[dst].set(
-                jnp.where(in_new, FOLLOWER, NOTMEMBER)
-            ),
-            log_term=d["log_term"].at[dst].set(new_logs["term"]),
-            log_cmd=d["log_cmd"].at[dst].set(new_logs["cmd"]),
-            log_val=d["log_val"].at[dst].set(new_logs["val"]),
-            log_cid=d["log_cid"].at[dst].set(new_logs["cid"]),
-            log_cmem=d["log_cmem"].at[dst].set(new_logs["cmem"]),
-            log_cmembers=d["log_cmembers"].at[dst].set(new_logs["cmembers"]),
-            log_len=d["log_len"].at[dst].set(new_ll),
-            **self._word_upd(w3, c3),
-        )
-
-        # --- HandleAppendEntriesResponse (:758-788)
-        b_aeresp = recv & (mtype == AERESP) & eq_term & (st_dst == LEADER)
-        res = u("mresult")
-        mmatch = u("mmatchIndex")
-        ni_cur = d["nextIndex"][dst, src]
-        ni_new = jnp.where(
-            res == RC_OK,
-            mmatch + 1,
-            jnp.where(
-                res == RC_MISMATCH,
-                jnp.maximum(ni_cur - 1, 1),
-                jnp.where(res == RC_NEEDSNAP, PENDING_SNAP_REQUEST, ni_cur),
-            ),
-        )
-        mi_new = jnp.where(
-            res == RC_OK,
-            d["matchIndex"].at[dst, src].set(mmatch),
-            d["matchIndex"],
-        )
-        s_aeresp = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[dst, src].set(ni_new),
-            matchIndex=mi_new,
-            pendingResponse=d["pendingResponse"].at[dst].set(
-                d["pendingResponse"][dst] & ~(jnp.int32(1) << src)
-            ),
-            msg_cnt=cnt_disc,
-        )
-
-        # --- HandleSnapshotRequest (:882-904)
-        b_snapreq = recv & (mtype == SNAPREQ) & eq_term & (st_dst == FOLLOWER)
-        sn_ll = u("mloglen")
-        sn_logs = {}
-        for n in ENTRY_FIELDS:
-            sn_logs[n] = jnp.stack([u(f"l{k}_{n}") for k in range(L)])
-        sn_is_cfg = (
-            (sn_logs["cmd"] == CMD_INIT)
-            | (sn_logs["cmd"] == CMD_ADD)
-            | (sn_logs["cmd"] == CMD_REMOVE)
-        )
-        sn_mask = (lanes < sn_ll) & sn_is_cfg
-        sn_idx = jnp.max(jnp.where(sn_mask, lanes + 1, 0))
-        sn_pos = jnp.clip(sn_idx - 1, 0)
-        sn_mci = u("mcommitIndex")
-        sq_key = self._pack(
-            mtype=SNAPRESP,
-            mterm=cur,
-            msuccess=1,
-            mmatchIndex=sn_ll,
-            msource=dst,
-            mdest=src,
-        )
-        w4, c4, _ex4, ovf4 = reply(sq_key)
-        s_snapreq = self._asm(
-            d,
-            commitIndex=d["commitIndex"].at[dst].set(sn_mci),
-            log_term=d["log_term"].at[dst].set(sn_logs["term"]),
-            log_cmd=d["log_cmd"].at[dst].set(sn_logs["cmd"]),
-            log_val=d["log_val"].at[dst].set(sn_logs["val"]),
-            log_cid=d["log_cid"].at[dst].set(sn_logs["cid"]),
-            log_cmem=d["log_cmem"].at[dst].set(sn_logs["cmem"]),
-            log_cmembers=d["log_cmembers"].at[dst].set(sn_logs["cmembers"]),
-            log_len=d["log_len"].at[dst].set(sn_ll),
-            config_id=d["config_id"].at[dst].set(sn_logs["cid"][sn_pos]),
-            config_members=d["config_members"].at[dst].set(
-                sn_logs["cmembers"][sn_pos]
-            ),
+    def _config_updates_from_log(self, d, dst, logs, cfg_pos, cfg_idx, mci):
+        """Config cache from the most recent config entry (:734-739):
+        id, member set, committed watermark; in_new = membership of dst
+        in the installed member set."""
+        cmembers = logs["cmembers"][cfg_pos]
+        upd = dict(
+            config_id=d["config_id"].at[dst].set(logs["cid"][cfg_pos]),
+            config_members=d["config_members"].at[dst].set(cmembers),
             config_committed=d["config_committed"].at[dst].set(
-                (sn_mci >= sn_idx).astype(jnp.int32)
+                (mci >= cfg_idx).astype(jnp.int32)
             ),
-            **self._word_upd(w4, c4),
         )
-
-        # --- HandleSnapshotResponse (:909-921)
-        b_snapresp = (
-            recv
-            & (mtype == SNAPRESP)
-            & eq_term
-            & (d["nextIndex"][dst, src] == PENDING_SNAP_RESPONSE)
-        )
-        s_snapresp = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[dst, src].set(u("mmatchIndex") + 1),
-            matchIndex=d["matchIndex"].at[dst, src].set(u("mmatchIndex")),
-            msg_cnt=cnt_disc,
-        )
-
-        branches = [
-            (b_upd, s_upd, A_UPDATETERM, jnp.asarray(False)),
-            (b_rvreq, s_rvreq, A_HANDLE_RVREQ, ovf1),
-            (b_rvresp, s_rvresp, A_HANDLE_RVRESP, jnp.asarray(False)),
-            (b_reject, s_reject, A_REJECT_AE, ovf2),
-            (b_accept, s_accept, A_ACCEPT_AE, ovf3 | ac_ovf),
-            (b_aeresp, s_aeresp, A_HANDLE_AERESP, jnp.asarray(False)),
-            (b_snapreq, s_snapreq, A_HANDLE_SNAPREQ, ovf4),
-            (b_snapresp, s_snapresp, A_HANDLE_SNAPRESP, jnp.asarray(False)),
-        ]
-        valid = jnp.asarray(False)
-        succ = s
-        rank = jnp.int32(-1)
-        ovf = jnp.asarray(False)
-        for b, sb, rk, ob in branches:
-            valid = valid | b
-            succ = jnp.where(b, sb, succ)
-            rank = jnp.where(b, jnp.int32(rk), rank)
-            ovf = ovf | (b & ob)
-        return valid, succ, rank, ovf
+        in_new = ((cmembers >> dst) & 1) > 0
+        return upd, in_new
 
     # ---------------- full expansion ----------------
 
@@ -887,57 +627,6 @@ class ReconfigRaftModel(ConfigRaftCommon):
         return succs, valid, rank, ovf
 
     # ---------------- initial states ----------------
-
-    def init_states(self) -> np.ndarray:
-        """Init — :324-338: pre-installed cluster; CHOOSE realized as the
-        lowest-index member subset and leader (WLOG under SYMMETRY)."""
-        p = self.p
-        S = p.n_servers
-        lay = self.layout
-        vec = lay.zeros((1,))
-        members = list(range(p.init_cluster_size))
-        mask = sum(1 << i for i in members)
-        leader = 0
-        vec[0, lay.sl("config_id")] = [1 if i in members else 0 for i in range(S)]
-        vec[0, lay.sl("config_members")] = [
-            mask if i in members else 0 for i in range(S)
-        ]
-        vec[0, lay.sl("config_committed")] = [
-            1 if i in members else 0 for i in range(S)
-        ]
-        vec[0, lay.sl("currentTerm")] = [1 if i in members else 0 for i in range(S)]
-        vec[0, lay.sl("state")] = [
-            LEADER if i == leader else FOLLOWER if i in members else NOTMEMBER
-            for i in range(S)
-        ]
-        ni = np.ones((S, S), np.int32)
-        mi = np.zeros((S, S), np.int32)
-        for j in members:
-            ni[leader, j] = 2
-            mi[leader, j] = 1
-        vec[0, lay.sl("nextIndex")] = ni.reshape(-1)
-        vec[0, lay.sl("matchIndex")] = mi.reshape(-1)
-        lt = np.zeros((S, p.max_log), np.int32)
-        lc = np.zeros((S, p.max_log), np.int32)
-        lcid = np.zeros((S, p.max_log), np.int32)
-        lcm = np.zeros((S, p.max_log), np.int32)
-        for i in members:
-            lt[i, 0] = 1
-            lc[i, 0] = CMD_INIT
-            lcid[i, 0] = 1
-            lcm[i, 0] = mask
-        vec[0, lay.sl("log_term")] = lt.reshape(-1)
-        vec[0, lay.sl("log_cmd")] = lc.reshape(-1)
-        vec[0, lay.sl("log_cid")] = lcid.reshape(-1)
-        vec[0, lay.sl("log_cmembers")] = lcm.reshape(-1)
-        vec[0, lay.sl("log_len")] = [1 if i in members else 0 for i in range(S)]
-        vec[0, lay.sl("commitIndex")] = [1 if i in members else 0 for i in range(S)]
-        for k in range(self.n_words):
-            vec[0, lay.sl(f"msg_w{k}")] = int(EMPTY)
-        vec[0, lay.sl("acked")] = ACK_NIL
-        return vec
-
-    # ---------------- invariants ----------------
 
     def _live_reconfig_p(self, states):
         """ReconfigurationCompletes antecedent — :992-996: some leader has
@@ -1175,46 +864,6 @@ class ReconfigRaftModel(ConfigRaftCommon):
             rec["msuccess"] = bool(u["msuccess"])
             rec["mmatchIndex"] = int(u["mmatchIndex"])
         return tuple(sorted(rec.items()))
-
-    def encode_msg(self, rec: tuple) -> tuple:
-        d = dict(rec)
-        mtype = {v: k for k, v in MTYPE_NAMES.items()}[d["mtype"]]
-        kw = dict(
-            mtype=mtype, mterm=d["mterm"], msource=d["msource"], mdest=d["mdest"]
-        )
-        if mtype == RVREQ:
-            kw.update(
-                mlastLogTerm=d["mlastLogTerm"], mlastLogIndex=d["mlastLogIndex"]
-            )
-        elif mtype == RVRESP:
-            kw.update(mvoteGranted=int(d["mvoteGranted"]))
-        elif mtype == AEREQ:
-            kw.update(
-                mprevLogIndex=d["mprevLogIndex"],
-                mprevLogTerm=d["mprevLogTerm"],
-                nentries=len(d["mentries"]),
-                mcommitIndex=d["mcommitIndex"],
-            )
-            if d["mentries"]:
-                kw.update(
-                    {f"e_{n}": v for n, v in self._encode_entry(d["mentries"][0]).items()}
-                )
-        elif mtype == AERESP:
-            inv_rc = {v: k for k, v in RC_NAMES.items()}
-            kw.update(mresult=inv_rc[d["mresult"]], mmatchIndex=d["mmatchIndex"])
-        elif mtype == SNAPREQ:
-            kw.update(
-                mloglen=len(d["mlog"]),
-                mcommitIndex=d["mcommitIndex"],
-                mmembers=sum(1 << j for j in d["mmembers"]),
-            )
-            for k, e in enumerate(d["mlog"]):
-                kw.update(
-                    {f"l{k}_{n}": v for n, v in self._encode_entry(e).items()}
-                )
-        elif mtype == SNAPRESP:
-            kw.update(msuccess=int(d["msuccess"]), mmatchIndex=d["mmatchIndex"])
-        return self.packer.pack(**kw)
 
     def encode(self, st: dict) -> np.ndarray:
         lay, p = self.layout, self.p

@@ -46,7 +46,7 @@ Log entries are (command, term, value) with value:
 
 from __future__ import annotations
 
-from .config_oracle_base import ConfigOracleBase
+from .config_oracle_base import ConfigOracleBase, last_term, rec
 
 import itertools
 
@@ -71,13 +71,8 @@ PENDING_SNAP_RESPONSE = -2  # :272
 NO_CONFIG = (0, frozenset(), False)  # NoConfig — :260-263
 
 
-def rec(**kw) -> tuple:
-    return tuple(sorted(kw.items()))
 
 
-def last_term(log) -> int:
-    """LastTerm — RaftWithReconfigAddRemove.tla:173."""
-    return log[-1][1] if log else 0
 
 
 def is_config_command(entry) -> bool:
@@ -131,6 +126,10 @@ class ReconfigRaftOracle(ConfigOracleBase):
         self.max_cluster = max_cluster_size
         self.thesis_bug = include_thesis_bug
         self.max_term = 1 + max_elections
+
+    MEMBERS_IDX = 1  # member-set slot of the config tuple
+    _config_for = staticmethod(config_for)
+    _mrre = staticmethod(most_recent_reconfig_entry)
 
     # ---------- state helpers ----------
 
@@ -195,39 +194,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
         out[request] -= 1
         out[response] = out.get(response, 0) + 1
         return frozenset(out.items())
-
-    def _receivable(self, st, m, mtype: str, equal_term: bool) -> bool:
-        """ReceivableMessage — :227-233."""
-        d = dict(m)
-        msgs = self._msgs(st)
-        if msgs.get(m, 0) < 1 or d["mtype"] != mtype:
-            return False
-        if equal_term:
-            return d["mterm"] == st["currentTerm"][d["mdest"]]
-        return d["mterm"] <= st["currentTerm"][d["mdest"]]
-
-    @staticmethod
-    def _norm_rec(m) -> tuple:
-        """Totally orderable stand-in for a record (mixed value types)."""
-
-        def norm_val(v):
-            if v is None:
-                return (0, 0)
-            if isinstance(v, bool):
-                return (1, int(v))
-            if isinstance(v, int):
-                return (2, v)
-            if isinstance(v, str):
-                return (3, v)
-            if isinstance(v, frozenset):
-                return (4, tuple(sorted(v)))
-            if isinstance(v, tuple):
-                return (5, tuple(norm_val(x) for x in v))
-            raise TypeError(v)
-
-        return tuple((k, norm_val(v)) for k, v in m)
-
-    # ---------- config helpers ----------
 
     def _has_pending_config(self, st, i) -> bool:
         """HasPendingConfigCommand — :248-249."""
@@ -327,113 +293,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
                 out.append((f"ResetWithSameIdentity({i})", s2))
         return out
 
-    def restart(self, st, i):
-        """Restart(i) — :346-358: keeps config, currentTerm, votedFor, log."""
-        if st["restartCtr"] >= self.max_restarts:
-            return None
-        return self._with(
-            st,
-            state=self._set(st["state"], i, FOLLOWER),
-            votesGranted=self._set(st["votesGranted"], i, frozenset()),
-            nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
-            matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
-            pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
-            commitIndex=self._set(st["commitIndex"], i, 0),
-            restartCtr=st["restartCtr"] + 1,
-        )
-
-    def update_term(self, st, m):
-        """UpdateTerm — :404-413 (any DOMAIN record, count may be 0)."""
-        d = dict(m)
-        i = d["mdest"]
-        if d["mterm"] <= st["currentTerm"][i]:
-            return None
-        return self._with(
-            st,
-            currentTerm=self._set(st["currentTerm"], i, d["mterm"]),
-            state=self._set(st["state"], i, FOLLOWER),
-            votedFor=self._set(st["votedFor"], i, None),
-        )
-
-    def request_vote(self, st, i):
-        """RequestVote(i) — :425-444: member-only, notifies the member set."""
-        if st["electionCtr"] >= self.max_elections:
-            return None
-        if st["state"][i] not in (FOLLOWER, CANDIDATE):
-            return None
-        members = st["config"][i][1]
-        if i not in members:
-            return None
-        reqs = {
-            rec(
-                mtype="RequestVoteRequest",
-                mterm=st["currentTerm"][i] + 1,
-                mlastLogTerm=last_term(st["log"][i]),
-                mlastLogIndex=len(st["log"][i]),
-                msource=i,
-                mdest=j,
-            )
-            for j in members
-            if j != i
-        }
-        msgs = self._send_multiple_once(self._msgs(st), reqs)
-        if msgs is None:
-            return None
-        return self._with(
-            st,
-            state=self._set(st["state"], i, CANDIDATE),
-            currentTerm=self._set(st["currentTerm"], i, st["currentTerm"][i] + 1),
-            votedFor=self._set(st["votedFor"], i, i),
-            votesGranted=self._set(st["votesGranted"], i, frozenset({i})),
-            electionCtr=st["electionCtr"] + 1,
-            messages=msgs,
-        )
-
-    def handle_request_vote_request(self, st, m):
-        """HandleRequestVoteRequest — :449-472."""
-        if not self._receivable(st, m, "RequestVoteRequest", equal_term=False):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        log_ok = d["mlastLogTerm"] > last_term(st["log"][i]) or (
-            d["mlastLogTerm"] == last_term(st["log"][i])
-            and d["mlastLogIndex"] >= len(st["log"][i])
-        )
-        grant = (
-            d["mterm"] == st["currentTerm"][i]
-            and log_ok
-            and st["votedFor"][i] in (None, j)
-        )
-        resp = rec(
-            mtype="RequestVoteResponse",
-            mterm=st["currentTerm"][i],
-            mvoteGranted=grant,
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._reply(self._msgs(st), resp, m)
-        if msgs is None:
-            return None
-        extra = {}
-        if grant:
-            extra["votedFor"] = self._set(st["votedFor"], i, j)
-        return self._with(st, messages=msgs, **extra)
-
-    def handle_request_vote_response(self, st, m):
-        """HandleRequestVoteResponse — :477-493."""
-        if not self._receivable(st, m, "RequestVoteResponse", equal_term=True):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if st["state"][i] != CANDIDATE:
-            return None
-        vg = st["votesGranted"][i] | {j} if d["mvoteGranted"] else st["votesGranted"][i]
-        return self._with(
-            st,
-            votesGranted=self._set(st["votesGranted"], i, vg),
-            messages=self._discard(self._msgs(st), m),
-        )
-
     def become_leader(self, st, i):
         """BecomeLeader(i) — :505-518: quorum of config[i].members; the vote
         set must itself be a subset of the member set."""
@@ -451,22 +310,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
             ),
             matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
             pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
-        )
-
-    def client_request(self, st, i, v):
-        """ClientRequest(i, v) — :525-540: also bounded by valueCtr per
-        term (:529)."""
-        if st["state"][i] != LEADER or st["acked"][v] is not None:
-            return None
-        term = st["currentTerm"][i]
-        if st["valueCtr"][term - 1] >= self.max_values_per_term:
-            return None
-        entry = (APPEND_CMD, term, v)
-        return self._with(
-            st,
-            log=self._set(st["log"], i, st["log"][i] + (entry,)),
-            acked=self._set(st["acked"], v, False),
-            valueCtr=self._set(st["valueCtr"], term - 1, st["valueCtr"][term - 1] + 1),
         )
 
     def advance_commit_index(self, st, i):
@@ -518,162 +361,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
         else:
             upd["commitIndex"] = self._set(st["commitIndex"], i, new_ci)
         return self._with(st, **upd)
-
-    def append_entries(self, st, i, j):
-        """AppendEntries(i, j) — :546-572: member-gated, snapshot-sentinel
-        gated, one-at-a-time flow control."""
-        if st["state"][i] != LEADER:
-            return None
-        if j not in st["config"][i][1]:
-            return None
-        ni = st["nextIndex"][i][j]
-        if ni < 0 or st["pendingResponse"][i][j]:
-            return None
-        log_i = st["log"][i]
-        prev_idx = ni - 1
-        prev_term = log_i[prev_idx - 1][1] if prev_idx > 0 else 0
-        last_entry = min(len(log_i), ni)
-        entries = tuple(log_i[ni - 1 : last_entry])
-        msg = rec(
-            mtype="AppendEntriesRequest",
-            mterm=st["currentTerm"][i],
-            mprevLogIndex=prev_idx,
-            mprevLogTerm=prev_term,
-            mentries=entries,
-            mcommitIndex=min(st["commitIndex"][i], last_entry),
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._send(self._msgs(st), msg)
-        if msgs is None:
-            return None
-        return self._with(
-            st,
-            pendingResponse=self._set2(st["pendingResponse"], i, j, True),
-            messages=msgs,
-        )
-
-    def _log_ok(self, st, i, d) -> bool:
-        """LogOk — :650-667 (strict empty-entries arm)."""
-        log_i = st["log"][i]
-        if d["mentries"] != ():
-            return (
-                d["mprevLogIndex"] > 0
-                and d["mprevLogIndex"] <= len(log_i)
-                and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
-            )
-        return (
-            d["mprevLogIndex"] == len(log_i)
-            and d["mprevLogIndex"] > 0
-            and d["mprevLogTerm"] == log_i[d["mprevLogIndex"] - 1][1]
-        )
-
-    def reject_append_entries_request(self, st, m):
-        """RejectAppendEntriesRequest — :669-693."""
-        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=False):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if d["mterm"] < st["currentTerm"][i]:
-            rc = STALE_TERM
-        elif i not in st["config"][i][1]:
-            rc = NEED_SNAPSHOT
-        elif (
-            d["mterm"] == st["currentTerm"][i]
-            and st["state"][i] == FOLLOWER
-            and not self._log_ok(st, i, d)
-        ):
-            rc = ENTRY_MISMATCH
-        else:
-            return None
-        resp = rec(
-            mtype="AppendEntriesResponse",
-            mterm=st["currentTerm"][i],
-            mresult=rc,
-            mmatchIndex=0,
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._reply(self._msgs(st), resp, m)
-        if msgs is None:
-            return None
-        return self._with(st, messages=msgs)
-
-    def accept_append_entries_request(self, st, m):
-        """AcceptAppendEntriesRequest — :716-753: append/truncate, then
-        derive config from the new log; may demote to NotMember."""
-        if not self._receivable(st, m, "AppendEntriesRequest", equal_term=True):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if st["state"][i] not in (FOLLOWER, CANDIDATE):
-            return None
-        if not self._log_ok(st, i, d):
-            return None
-        if i not in st["config"][i][1]:
-            return None
-        log_i = st["log"][i]
-        index = d["mprevLogIndex"] + 1
-        if d["mentries"] != () and len(log_i) == d["mprevLogIndex"]:
-            new_log = log_i + (d["mentries"][0],)  # CanAppend (:705-707)
-        elif d["mentries"] != () and len(log_i) >= index:
-            # NeedsTruncation (:709-711) + TruncateLog (:713-714)
-            new_log = log_i[: d["mprevLogIndex"]] + (d["mentries"][0],)
-        else:
-            new_log = log_i
-        cfg_idx, cfg_entry = most_recent_reconfig_entry(new_log)
-        new_config = config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
-        resp = rec(
-            mtype="AppendEntriesResponse",
-            mterm=st["currentTerm"][i],
-            mresult=OK,
-            mmatchIndex=d["mprevLogIndex"] + len(d["mentries"]),
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._reply(self._msgs(st), resp, m)
-        if msgs is None:
-            return None
-        return self._with(
-            st,
-            config=self._set(st["config"], i, new_config),
-            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
-            state=self._set(
-                st["state"],
-                i,
-                FOLLOWER if i in new_config[1] else NOTMEMBER,
-            ),
-            log=self._set(st["log"], i, new_log),
-            messages=msgs,
-        )
-
-    def handle_append_entries_response(self, st, m):
-        """HandleAppendEntriesResponse — :758-788."""
-        if not self._receivable(st, m, "AppendEntriesResponse", equal_term=True):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if st["state"][i] != LEADER:
-            return None
-        ni = st["nextIndex"]
-        mi = st["matchIndex"]
-        if d["mresult"] == OK:
-            ni = self._set2(ni, i, j, d["mmatchIndex"] + 1)
-            mi = self._set2(mi, i, j, d["mmatchIndex"])
-        elif d["mresult"] == ENTRY_MISMATCH:
-            ni = self._set2(ni, i, j, max(st["nextIndex"][i][j] - 1, 1))
-        elif d["mresult"] == NEED_SNAPSHOT:
-            ni = self._set2(ni, i, j, PENDING_SNAP_REQUEST)
-        # StaleTerm: no index changes (:784-785)
-        return self._with(
-            st,
-            nextIndex=ni,
-            matchIndex=mi,
-            pendingResponse=self._set2(st["pendingResponse"], i, j, False),
-            messages=self._discard(self._msgs(st), m),
-        )
-
-    # ---------- reconfiguration (:795-921) ----------
 
     def append_add_server_command(self, st, i, add_member):
         """AppendAddServerCommandToLog — :795-824."""
@@ -741,77 +428,6 @@ class ReconfigRaftOracle(ConfigOracleBase):
                 config_for(len(new_log), entry, st["commitIndex"][i]),
             ),
             removeReconfigCtr=st["removeReconfigCtr"] + 1,
-        )
-
-    def send_snapshot(self, st, i, j):
-        """SendSnapshot(i, j) — :862-878: embeds the leader's whole log."""
-        if st["state"][i] != LEADER:
-            return None
-        if j not in st["config"][i][1]:
-            return None
-        if st["nextIndex"][i][j] != PENDING_SNAP_REQUEST:
-            return None
-        msg = rec(
-            mtype="SnapshotRequest",
-            mterm=st["currentTerm"][i],
-            mlog=st["log"][i],
-            mcommitIndex=st["commitIndex"][i],
-            mmembers=st["config"][i][1],
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._send(self._msgs(st), msg)
-        if msgs is None:
-            return None
-        return self._with(
-            st,
-            nextIndex=self._set2(st["nextIndex"], i, j, PENDING_SNAP_RESPONSE),
-            messages=msgs,
-        )
-
-    def handle_snapshot_request(self, st, m):
-        """HandleSnapshotRequest — :882-904."""
-        if not self._receivable(st, m, "SnapshotRequest", equal_term=True):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if st["state"][i] != FOLLOWER:
-            return None
-        cfg_idx, cfg_entry = most_recent_reconfig_entry(d["mlog"])
-        resp = rec(
-            mtype="SnapshotResponse",
-            mterm=st["currentTerm"][i],
-            msuccess=True,
-            mmatchIndex=len(d["mlog"]),
-            msource=i,
-            mdest=j,
-        )
-        msgs = self._reply(self._msgs(st), resp, m)
-        if msgs is None:
-            return None
-        return self._with(
-            st,
-            commitIndex=self._set(st["commitIndex"], i, d["mcommitIndex"]),
-            log=self._set(st["log"], i, d["mlog"]),
-            config=self._set(
-                st["config"], i, config_for(cfg_idx, cfg_entry, d["mcommitIndex"])
-            ),
-            messages=msgs,
-        )
-
-    def handle_snapshot_response(self, st, m):
-        """HandleSnapshotResponse — :909-921."""
-        if not self._receivable(st, m, "SnapshotResponse", equal_term=True):
-            return None
-        d = dict(m)
-        i, j = d["mdest"], d["msource"]
-        if st["nextIndex"][i][j] != PENDING_SNAP_RESPONSE:
-            return None
-        return self._with(
-            st,
-            nextIndex=self._set2(st["nextIndex"], i, j, d["mmatchIndex"] + 1),
-            matchIndex=self._set2(st["matchIndex"], i, j, d["mmatchIndex"]),
-            messages=self._discard(self._msgs(st), m),
         )
 
     def reset_with_same_identity(self, st, i):

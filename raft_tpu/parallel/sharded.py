@@ -145,24 +145,26 @@ class ShardedBFS:
         self.TOPSZ = self._lsm.TOPSZ
 
         self._chunk_fn_cache: dict[int, object] = {}
+        self._occ_cache: dict[bytes, object] = {}
         self._journals = None  # (jps, jpl, jcand) per shard after run()
         self._init_by_shard = None
 
     # ---------------- LSM adapters (per-chip [D, lanes] runs) ----
 
+    def _occ_dev(self):
+        """Occupancy flags as a device array, uploaded once per distinct
+        pattern (a fresh upload per chunk is a whole tunnel dispatch —
+        same cache as DeviceBFS._occ_dev)."""
+        key = bytes(self._lsm.occ)
+        arr = self._occ_cache.get(key)
+        if arr is None:
+            arr = jnp.asarray(np.asarray(self._lsm.occ, dtype=bool))
+            self._occ_cache[key] = arr
+        return arr
+
     def _lsm_export(self) -> list[np.ndarray]:
         """Per-chip sorted real fingerprints (checkpoint format)."""
-        parts = self._lsm.export_host()
-        out = []
-        for d in range(self.D):
-            cat = (
-                np.concatenate([p[d] for p in parts])
-                if parts else np.empty(0, np.uint64)
-            )
-            cat = cat[cat != np.uint64(U64_MAX)]
-            cat.sort()
-            out.append(cat)
-        return out
+        return self._lsm.export_real()
 
     def _lsm_seed(self, per_shard: list[np.ndarray]):
         n = max((len(a) for a in per_shard), default=0)
@@ -596,7 +598,7 @@ class ShardedBFS:
             max_fc = int(fcounts.max())
             chunks_done = 0
             for cursor in range(0, max_fc, C):
-                occ_dev = jnp.asarray(np.asarray(self._lsm.occ, dtype=bool))
+                occ_dev = self._occ_dev()
                 chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
                 (state["next_buf"], state["jps"], state["jpl"],
                  state["jcand"], state["viol"], state["stats"], new_run,

@@ -108,6 +108,8 @@ def row2():
                     frontier_cap=1 << 19, seen_cap=1 << 23,
                     journal_cap=1 << 23, max_frontier_cap=1 << 21,
                     max_seen_cap=1 << 25, max_journal_cap=1 << 25)
+    dev.run(max_depth=1)  # compile outside the budgeted window (the v3
+    # canonicalizer's three tiers push compile past 2 min on this chip)
     deep = dev.run(time_budget_s=BUDGET, collect_metrics=True)
     last = deep.metrics[-1] if deep.metrics else {}
     out["deep"] = {
@@ -134,8 +136,11 @@ def row3():
     if not g.ok:
         out["error"] = "parity gate failed"
         return out
+    # depth 15 (round 4): at depth 13 the whole device run is ~6 s of
+    # mostly per-wave dispatch latency and the 1-core oracle arm's
+    # wall-clock fluctuates 2x run-to-run, so the ratio was noise
     out.update(cmp_and_deep(setup.model, setup.invariants,
-                            oracle_for_setup(setup), cmp_depth=13))
+                            oracle_for_setup(setup), cmp_depth=15))
     return out
 
 
@@ -153,7 +158,7 @@ def row4():
         out["error"] = "parity gate failed"
         return out
     out.update(cmp_and_deep(setup.model, setup.invariants,
-                            oracle_for_setup(setup), cmp_depth=13))
+                            oracle_for_setup(setup), cmp_depth=15))
     return out
 
 
@@ -182,6 +187,7 @@ def row5():
     dev = DeviceBFS(setup.model, invariants=setup.invariants, symmetry=True,
                     chunk=1024, frontier_cap=1 << 17, seen_cap=1 << 21,
                     journal_cap=1 << 21)
+    dev.run(max_depth=1)  # compile outside the budgeted window
     deep = dev.run(time_budget_s=BUDGET)
     out["bounded_bfs"] = {
         "distinct": deep.distinct,

@@ -45,8 +45,10 @@ def _model_raft5():
                    msg_slots=64)
     return (cached_model(p),
             ("LeaderHasAllAckedValues", "NoLogDivergence"),
+            # depth 9: past the all-tied early waves (tie rate ~35%,
+            # tie groups <= 2 dominate) — the regime deep runs live in
             dict(chunk=2048, frontier_cap=1 << 19, seen_cap=1 << 23,
-                 warm_depth=7))
+                 warm_depth=9))
 
 
 WL = {"raft3": _model_raft3, "fsync": _model_fsync, "raft5": _model_raft5}
@@ -86,8 +88,8 @@ def main():
           f"({results['meta']['when']}). Produced by "
           "`python scripts/profile_workloads.py`; stage semantics in "
           "`raft_tpu/checker/profile.py`. Shares are of the per-chunk "
-          "stage sum (fused_chunk / finalize_merge are separate rows: "
-          "the fused production program and the per-WAVE seen merge).",
+          "stage sum (fused_chunk / lsm_merge_2r0 are separate rows: "
+          "the fused production program and one level-0 LSM run merge).",
           ""]
     for name in pick:
         md += [f"## {name}", "", "```", render(results[name]), "```", ""]

@@ -80,11 +80,14 @@ def test_reconfig_add_remove_completes_clean():
 
 
 @pytest.mark.skip(
-    reason="joint full-state liveness graphs exceed 10 min to build even "
-    "at 3 servers / MaxElections=0 (snapshot + dual-config flows); the "
-    "formula kernels are covered by the spot-check test below and the "
-    "machinery by the AddRemove run above — run offline with a budget "
-    "for the full proof"
+    reason="the round-4 vectorized graph build removed the old 10-min "
+    "host-dict bottleneck, but the joint spec's kernels blow up LLVM "
+    "('Cannot allocate memory', exit 139) when the liveness checker "
+    "compiles them at its batch shapes on this host's CPU backend — "
+    "reproduced at chunk 2048/512/256. The formula kernels are covered "
+    "by the spot-check test below and the machinery by the AddRemove "
+    "full proof above; run the joint proof on a host whose XLA CPU "
+    "build survives the compile (or on device)"
 )
 def test_joint_completes_clean():
     from raft_tpu.models.joint_raft import JointRaftParams, cached_model
