@@ -29,19 +29,45 @@ import jax
 # the same collision budget). Must run before any jax arrays are created.
 jax.config.update("jax_enable_x64", True)
 
-# Persistent compilation cache: the TPU tunnel's remote-compile service
-# costs ~20 s per program shape (measured round 4 — even a 64k-lane
-# sort-concat), and the checker's LSM merge ladder + chunk programs span
-# a dozen shapes, so cold processes paid minutes of pure compile. The
-# on-disk cache drops repeat compiles to ~0.1 s across processes.
-# Override the location with RAFT_TPU_COMPCACHE (empty string disables).
-_cache_dir = os.environ.get(
-    "RAFT_TPU_COMPCACHE",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"),
-)
-if _cache_dir:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+_compcache_checked = False
+
+
+def enable_compcache() -> None:
+    """Persistent compilation cache, TPU backend ONLY.
+
+    The TPU tunnel's remote-compile service costs ~20 s per program
+    shape (measured round 4 — even a 64k-lane sort-concat), and the
+    checker's LSM merge ladder + chunk programs span a dozen shapes, so
+    cold processes paid minutes of pure compile; the on-disk cache drops
+    repeat compiles to ~0.1 s across processes. It is NOT enabled for
+    the CPU backend: XLA:CPU cache entries written by tunnel-connected
+    processes carry mismatched target-machine features
+    (+prefer-no-scatter etc.) and ABORT on load (observed SIGABRT in
+    AllToAllThunk). Called lazily once the backend is known, from
+    Canonicalizer.for_model/__init__, Simulator and LivenessChecker —
+    the chokepoints every checker/simulation path goes through. Override
+    the location with RAFT_TPU_COMPCACHE (empty string disables)."""
+    global _compcache_checked
+    if _compcache_checked:
+        return
+    _compcache_checked = True
+    cache_dir = os.environ.get(
+        "RAFT_TPU_COMPCACHE",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        ),
+    )
+    if not cache_dir:
+        return
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return
+    if platform == "cpu":
+        return
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 __version__ = "0.1.0"

@@ -75,7 +75,6 @@ class DeviceBFS:
 
     GROWTH = GROWTH
     HEADROOM = HEADROOM
-    CONSOL_EVERY = 16  # chunk inserts between mid-wave LSM repacks
 
     def __init__(
         self,
@@ -115,10 +114,7 @@ class DeviceBFS:
         # (shared implementation: checker/lsm.py)
         self.R0 = pow2_at_least(self.VC)
         self.SCAP = self.MAX_SCAP  # capacity bound (kept for callers)
-        self._lsm = RunLSM(
-            r0=self.R0, topsz=pow2_at_least(self.MAX_SCAP),
-            init_budget=seen_cap,
-        )
+        self._lsm = RunLSM(r0=self.R0, topsz=pow2_at_least(self.MAX_SCAP))
         self.TOPSZ = self._lsm.TOPSZ
         self.canon = Canonicalizer.for_model(
             model, symmetry=symmetry, seed=fingerprint_seed
@@ -417,6 +413,22 @@ class DeviceBFS:
                 raise OverflowError(
                     "seen-set capacity overflow; raise max_seen_cap"
                 )
+            # a wave whose new count could outgrow even the MAXIMALLY
+            # grown frontier will abort mid-wave (not resumable), so
+            # spill a resumable snapshot BEFORE attempting it (throttled:
+            # every wave in this regime would re-export the whole seen
+            # set, which can rival wave time on wide plateaus)
+            if (
+                checkpoint_path is not None
+                and fcount * self.HEADROOM > self.MAX_FCAP
+                and time.perf_counter() - last_ckpt > checkpoint_every_s / 4
+            ):
+                self._save_checkpoint(
+                    checkpoint_path, frontier, jparent, jcand, fcount,
+                    scount, distinct, total, terminal, depth, base_gid,
+                    gen_prev, depth_counts,
+                )
+                last_ckpt = time.perf_counter()
             tw = time.perf_counter()
             chunks_done = 0
             for cursor in range(0, fcount, C):
@@ -428,10 +440,6 @@ class DeviceBFS:
                 )
                 self._lsm.insert(new_run)
                 chunks_done += 1
-                # keep the probed-run count bounded within big waves: every
-                # CONSOL_EVERY inserts, repack (bound = worst-case new)
-                if chunks_done % self.CONSOL_EVERY == 0:
-                    self._lsm.consolidate(scount + chunks_done * self.VC)
             # one host round-trip per wave: stats and the invariant fold
             # fetched together (two device_gets double the tunnel RTT on
             # small configs, where per-wave latency dominates)
@@ -475,8 +483,15 @@ class DeviceBFS:
             frontier, next_buf, jparent, jcand = self._maybe_grow(
                 ncount, frontier, next_buf, jparent, jcand, scount - n0
             )
-            # bound LSM padding waste: when the occupied lanes exceed 4x
-            # the real count, repack (amortized; a rare big sort)
+            # Bound LSM padding waste: when the occupied lanes exceed 4x
+            # the real count, repack (rare). NOTE: consolidation compiles
+            # a program per (occupied-shapes, target) signature at ~20 s
+            # each on the tunnel's remote-compile service, so it must
+            # stay RARE — a prior mid-wave every-16-chunks repack spent
+            # more wall-clock compiling consolidators than checking
+            # states on deep runs. In-wave runs are cheap to carry: the
+            # binary cascade keeps at most ~log2(chunks) of them and
+            # empty-level probes are cond-skipped.
             if self._lsm.lanes() > max(4 * scount, 1 << 21):
                 self._lsm.consolidate(scount)
             if (

@@ -76,6 +76,9 @@ class LivenessChecker:
 
     def __init__(self, model, properties: tuple[str, ...], chunk: int = 512,
                  max_states: int = 8_000_000):
+        from .. import enable_compcache
+
+        enable_compcache()
         self.model = model
         self.properties = tuple(properties)
         self.chunk = chunk

@@ -36,13 +36,12 @@ def pow2_at_least(n: int) -> int:
 class RunLSM:
     """``r0``: level-0 run lanes (a chunk's emission width, pow2);
     ``topsz``: top-level lane cap (>= the engine's max seen capacity);
-    ``init_budget``: pre-create levels covering this many lanes so early
-    growth does not retrace the chunk program; ``lead_shape``: leading
-    batch axes of every run array (() or (D,)); ``put``: host->device
-    placement for empties (defaults to jnp.asarray); ``jit_kw``: extra
-    jax.jit kwargs for merge programs (e.g. out_shardings)."""
+    ``lead_shape``: leading batch axes of every run array (() or (D,));
+    ``put``: host->device placement for empties (defaults to
+    jnp.asarray); ``jit_kw``: extra jax.jit kwargs for merge programs
+    (e.g. out_shardings)."""
 
-    def __init__(self, r0: int, topsz: int, init_budget: int,
+    def __init__(self, r0: int, topsz: int,
                  lead_shape: tuple[int, ...] = (), put=None, jit_kw=None):
         assert r0 and (r0 & (r0 - 1)) == 0, "r0 must be a power of two"
         self.R0 = r0
@@ -50,8 +49,12 @@ class RunLSM:
         self._lead = lead_shape
         self._put = put if put is not None else jnp.asarray
         self._jit_kw = dict(jit_kw or {})
+        # Pre-create the FULL ladder up to TOPSZ: empty levels all alias
+        # one cached sentinel constant per size (no HBM until occupied),
+        # while creating a level later changes the engine's chunk-program
+        # ARITY — a whole retrace (~20 s remote compile) mid-run.
         self._init_levels = 1
-        while self.lv_size(self._init_levels - 1) < min(init_budget, self.TOPSZ):
+        while self.lv_size(self._init_levels - 1) < self.TOPSZ:
             self._init_levels += 1
         self._merge_cache: dict = {}
         self._empty_cache: dict[int, object] = {}

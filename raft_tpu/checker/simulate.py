@@ -53,6 +53,9 @@ class Simulator:
         max_behavior_depth: int = 50,
         seed: int = 0,
     ):
+        from .. import enable_compcache
+
+        enable_compcache()
         self.model = model
         self.invariants = tuple(invariants)
         self.R = walks

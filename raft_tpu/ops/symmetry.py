@@ -122,6 +122,9 @@ class Canonicalizer:
         the identity slots) supplies its own via ``make_canonicalizer``;
         the returned object provides the same ``fingerprints`` /
         ``_fingerprints`` / ``symmetry`` surface the checkers use."""
+        from .. import enable_compcache
+
+        enable_compcache()  # covers custom make_canonicalizer models too
         if hasattr(model, "make_canonicalizer"):
             return model.make_canonicalizer(symmetry, seed=seed)
         return cls(
@@ -148,6 +151,9 @@ class Canonicalizer:
         seed: int = 0,
         mode: str = "auto",
     ):
+        from .. import enable_compcache
+
+        enable_compcache()  # direct constructions (tests, tools)
         S = layout.n_servers
         VL = layout.view_len
         assert VL is not None

@@ -92,7 +92,6 @@ class ShardedBFS:
 
     GROWTH = GROWTH
     HEADROOM = HEADROOM
-    CONSOL_EVERY = 16  # chunk inserts between mid-wave LSM repacks
 
     def __init__(
         self,
@@ -138,7 +137,7 @@ class ShardedBFS:
         self._sharding = NamedSharding(self.mesh, P(AXIS))
         self._lsm = RunLSM(
             r0=self.R0, topsz=pow2_at_least(self.MAX_SCAP),
-            init_budget=seen_cap, lead_shape=(self.D,),
+            lead_shape=(self.D,),
             put=lambda h: jax.device_put(h, self._sharding),
             jit_kw={"out_shardings": self._sharding},
         )
@@ -610,10 +609,6 @@ class ShardedBFS:
                 )
                 self._lsm.insert(new_run)
                 chunks_done += 1
-                if chunks_done % self.CONSOL_EVERY == 0:
-                    self._lsm.consolidate(
-                        int(scounts.max()) + chunks_done * self.D * self.RC
-                    )
             stats_h, viol_h = jax.device_get((state["stats"], state["viol"]))
             stats_h = np.asarray(stats_h)  # [D,6]
             viol_h = np.asarray(viol_h)  # [D,K]
