@@ -45,10 +45,12 @@ def _model_raft5():
                    msg_slots=64)
     return (cached_model(p),
             ("LeaderHasAllAckedValues", "NoLogDivergence"),
-            # depth 9: past the all-tied early waves (tie rate ~35%,
-            # tie groups <= 2 dominate) — the regime deep runs live in
+            # depth 10: past the all-tied early waves (tie rate ~35%
+            # with groups <= 2 dominating; at depth 9 heavy-tie lanes
+            # still exceed the B//16 compaction budget and the cond
+            # falls back to the full table) — deep runs live here
             dict(chunk=2048, frontier_cap=1 << 19, seen_cap=1 << 23,
-                 warm_depth=9))
+                 warm_depth=10))
 
 
 WL = {"raft3": _model_raft3, "fsync": _model_fsync, "raft5": _model_raft5}

@@ -84,7 +84,7 @@ def test_auto_equals_bruteforce(name):
 def test_auto_equals_bruteforce_tie_heavy(name):
     # a batch of replicated Init states is 100% signature-tied with
     # S-sized tie groups, forcing the lax.cond full-table branch
-    # (heavy lanes > B//16); interleave with distinct states so every
+    # (heavy lanes > B//8); interleave with distinct states so every
     # tier lands in one batch
     model, _oracle, _states, vecs = states_of(name, depth=3, cap=40)
     reps = np.repeat(model.init_states(), 200, axis=0)
