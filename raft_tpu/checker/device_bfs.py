@@ -46,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.hashing import U64_MAX
+from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
 from .lsm import RunLSM, pow2_at_least
@@ -204,7 +204,7 @@ class DeviceBFS:
         # `runs` already (the cascade is enqueued before the next chunk
         # call), so cross-chunk in-wave dedup falls out of the same probe.
         # Empty levels skip their binary search at runtime via cond.
-        fresh = fps != U64_MAX
+        fresh = ne_u64(fps, U64_MAX)
         for i, r in enumerate(runs):
             hit = lax.cond(
                 occ[i],
@@ -213,9 +213,8 @@ class DeviceBFS:
                 r,
             )
             fresh = fresh & ~hit
-        order = jnp.argsort(fps, stable=True)
-        rf = fps[order]
-        first_s = jnp.ones((VC,), bool).at[1:].set(rf[1:] != rf[:-1])
+        rf, order = sort_u64_with_idx(fps)
+        first_s = jnp.ones((VC,), bool).at[1:].set(ne_u64(rf[1:], rf[:-1]))
         first = jnp.zeros((VC,), bool).at[order].set(first_s)
         new = fresh & first
         n_new = jnp.sum(new)
@@ -235,9 +234,10 @@ class DeviceBFS:
         # better than sort-concat for merging sorted sets, but measures
         # 47x SLOWER on the TPU (370ms vs 7.8ms at 1M lanes): arbitrary-
         # index scatters serialize on this hardware while XLA's bitonic
-        # sort is fast. All LSM merges therefore use sort-concat, and the
-        # per-chunk sort below is only R0 = 2^ceil(log2(VC)) lanes.
-        new_run = jnp.sort(jnp.where(new, fps, U64_MAX))
+        # sort is fast. All LSM merges therefore use sort-concat (as
+        # 2-key u32 sorts — hashing.py), and the per-chunk sort below is
+        # only R0 = 2^ceil(log2(VC)) lanes.
+        new_run = sort_u64(jnp.where(new, fps, U64_MAX))
         if self.R0 > VC:
             new_run = jnp.concatenate(
                 [new_run, jnp.full((self.R0 - VC,), U64_MAX, jnp.uint64)]
@@ -563,14 +563,15 @@ class DeviceBFS:
         match too — states explored before the checkpoint (including Init)
         were only checked against the original run's invariants, so a
         resume with different invariants would silently skip them."""
-        # hashv marks fingerprint-formula revisions. v3 (round 4: sort-
-        # free multiset bag hash + signature-pruned permutation min,
-        # ops/symmetry.py) changed every fingerprint, so all pre-v3
-        # checkpoints are refused on load — conservative and sound.
+        # hashv marks fingerprint-formula revisions. v4 (round 5: u32
+        # stream-pair mixing + additive bag multiset combine,
+        # ops/hashing.py + ops/symmetry.py) changed every fingerprint, so
+        # all pre-v4 checkpoints are refused on load — conservative and
+        # sound.
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"
             f"/sym={self.canon.symmetry}/seed={self.canon.seed}"
-            f"/hashv=3/inv={','.join(self.invariants)}"
+            f"/hashv=4/inv={','.join(self.invariants)}"
         )
 
     def _save_checkpoint(

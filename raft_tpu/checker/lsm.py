@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.hashing import U64_MAX
+from ..ops.hashing import U64_MAX, sort_u64
 
 
 def pow2_at_least(n: int) -> int:
@@ -95,14 +95,15 @@ class RunLSM:
         return fn
 
     def _merge(self, a, b, out: int | None = None):
-        """Per-row sort-concat merge along the lane axis."""
+        """Per-row sort-concat merge along the lane axis (2-key u32 sort:
+        a u64 lax.sort is ~300x slower on this TPU, ops/hashing.py)."""
         key = (a.shape[-1], b.shape[-1], out)
 
         def build():
             if out is None:
-                return lambda x, y: jnp.sort(
+                return lambda x, y: sort_u64(
                     jnp.concatenate([x, y], axis=-1), axis=-1)
-            return lambda x, y: jnp.sort(
+            return lambda x, y: sort_u64(
                 jnp.concatenate([x, y], axis=-1), axis=-1)[..., :out]
 
         return self._jit(key, build)(a, b)
@@ -170,7 +171,7 @@ class RunLSM:
         key = ("consol", tuple(r.shape[-1] for r in occ_runs), target)
 
         def build():
-            return lambda *rs: jnp.sort(
+            return lambda *rs: sort_u64(
                 jnp.concatenate(rs, axis=-1), axis=-1)[..., :target]
 
         merged = self._jit(key, build)(*occ_runs)

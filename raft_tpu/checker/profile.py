@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.hashing import U64_MAX
+from ..ops.hashing import U64_MAX, ne_u64, sort_u64
 from .device_bfs import DeviceBFS
 from .util import probe_sorted as _probe
 
@@ -169,7 +169,7 @@ def profile_stages(
 
     # ---- stage 5: emit the chunk's sorted run ----
     def run_emit(f):
-        nr = jnp.sort(f)
+        nr = sort_u64(f)
         if R0 > VC:
             nr = jnp.concatenate(
                 [nr, jnp.full((R0 - VC,), U64_MAX, jnp.uint64)]
@@ -180,7 +180,7 @@ def profile_stages(
 
     # ---- stage 5b: scatter into frontier + journal ----
     def scatter(flatc, fps):
-        new = fps != U64_MAX
+        new = ne_u64(fps, U64_MAX)
         npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
         bdst = jnp.where(new, jnp.minimum(npos, FCAP), FCAP)
         nb = jnp.zeros((FCAP + 1, W), jnp.int32).at[bdst].set(flatc)
@@ -202,7 +202,7 @@ def profile_stages(
     # ---- LSM merge costs (level 0 measured; series fitted n log n) ----
     r0a = run_emit(fps)
     st["lsm_merge_2r0"] = _time(
-        jax.jit(lambda a, b: jnp.sort(jnp.concatenate([a, b]))), r0a, r0a,
+        jax.jit(lambda a, b: sort_u64(jnp.concatenate([a, b]))), r0a, r0a,
         reps=reps,
     )
     null = st["null_dispatch"]

@@ -6,16 +6,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.hashing import eq_u64
+
 GROWTH = 4  # enlarge factor per growth step
 HEADROOM = 3  # grow when the next wave could need more than cap/HEADROOM
 I32_MAX = np.int32(2**31 - 1)  # "no violation" sentinel in journal folds
 
 
 def probe_sorted(sorted_arr, vals):
-    """Membership of vals in a sorted u64 array padded with U64_MAX."""
+    """Membership of vals in a sorted u64 array padded with U64_MAX.
+    (u64 searchsorted is fast on this TPU; elementwise u64 == is not —
+    the equality check decomposes to u32, ops/hashing.py.)"""
     pos = jnp.searchsorted(sorted_arr, vals)
     pos = jnp.clip(pos, 0, sorted_arr.shape[0] - 1)
-    return sorted_arr[pos] == vals
+    return eq_u64(sorted_arr[pos], vals)
 
 
 def next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:

@@ -10,6 +10,14 @@ Reproduces TLC's distinct-state semantics for cfgs that declare
   - SYMMETRY: two states related by a server permutation are the same
     distinct state (``Raft.tla:116``).
 
+Fingerprint formula v4 (round 5): identical STRUCTURE to v3 below, but
+all mixing arithmetic runs as two independent u32 streams combined into
+one u64 at the end (u64 multiplies/compares are ~400x/180x slow on this
+TPU backend — measured numbers in ops/hashing.py), and the bag multiset
+combine is ADDITION mod 2^32 rather than XOR (nonlinear carries; round-4
+advisor note). Every fingerprint changed vs v3 (hashv=4 in the
+checkpoint identity).
+
 Fingerprint formula v3 (round 4 — the perf round). Two changes vs the
 round-1..3 formula (min of a positional hash over ALL S! permutations of
 the slot-sorted view):
@@ -66,7 +74,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .hashing import U64_MAX, hash_lanes, mix64
+from .hashing import (
+    KA,
+    KB,
+    U64_MAX,
+    _reduce_pair,
+    combine_pair,
+    eq_u64,
+    ge_u64,
+    hash_lanes_pair,
+    mix32,
+)
 from .packing import EMPTY, BitPacker, WidePacker
 from ..models.base import Layout
 
@@ -83,11 +101,50 @@ def _host_mix64(z: int) -> int:
     return z ^ (z >> 31)
 
 
-def _salt(field_offset: int, role: int) -> np.uint64:
-    """Deterministic per-(field, role) salt for signature folds. Depends
-    only on the field's layout offset and the fold role — never on a
-    server index (equivariance)."""
-    return np.uint64(_host_mix64(field_offset * 0x100 + role + 0x5A17))
+def _salt(field_offset: int, role: int) -> tuple[np.uint32, np.uint32]:
+    """Deterministic per-(field, role) u32 salt pair for signature folds.
+    Depends only on the field's layout offset and the fold role — never
+    on a server index (equivariance)."""
+    z = _host_mix64(field_offset * 0x100 + role + 0x5A17)
+    return np.uint32(z >> 32), np.uint32(z & 0xFFFFFFFF)
+
+
+# ---- u32 stream-pair helpers (v4: all device hashing avoids u64 muls) ----
+
+
+def _pmix(x, salt):
+    """int array -> (u32, u32) mixed stream pair under a salt pair."""
+    sa, sb = salt
+    xx = x.astype(jnp.uint32)
+    return mix32(xx * KA + sa), mix32(xx * KB + sb)
+
+
+def _pfold(pair, salt):
+    """Re-avalanche an existing stream pair under a salt pair."""
+    sa, sb = salt
+    a, b = pair
+    return mix32(a + sa), mix32(b + sb)
+
+
+def _padd(p, q):
+    return p[0] + q[0], p[1] + q[1]
+
+
+def _pwhere(cond, p, zero=np.uint32(0)):
+    return jnp.where(cond, p[0], zero), jnp.where(cond, p[1], zero)
+
+
+def _psum_last(p):
+    """Sum a stream pair over the LAST axis with one reduce op (two
+    reduces over a shared producer hit the fusion cliff — hashing.py)."""
+    return _reduce_pair(p[0], p[1], op="sum")
+
+
+def _pgather(p, idx):
+    return (
+        jnp.take_along_axis(p[0], idx, axis=1),
+        jnp.take_along_axis(p[1], idx, axis=1),
+    )
 
 
 def _adj_swap_products(S: int):
@@ -246,8 +303,9 @@ class Canonicalizer:
             [i for i in range(VL) if i not in bag_lanes], dtype=np.int32
         )
         if self.prune:
-            # tier-2 static tables: all products of DISJOINT adjacent
-            # transpositions (8 perms at S=5). Applied to the signature-
+            # tier-2 static tables: all non-identity products of DISJOINT
+            # adjacent transpositions (7 at S=5; the identity is tier 1's
+            # argsort). Applied to the signature-
             # SORTED view these are exactly the block permutations of any
             # tie pattern whose groups have size <= 2 — measured to be
             # >98% of tied states past depth ~9 on the 5-server workload
@@ -294,33 +352,41 @@ class Canonicalizer:
 
     # ---------------- the v3 hash ----------------
 
-    def _bag_hash(self, v):
-        """Multiset hash of the message bag region of [B, VL] views:
-        XOR over occupied slots of a position-independent record hash
-        (slots hold distinct keys by construction, so XOR cannot cancel)."""
+    def _bag_hash_pair(self, v):
+        """Multiset hash of the message bag region of [B, VL] views as a
+        (u32, u32) stream pair: occupied slots' position-independent
+        record hashes combine by ADDITION mod 2^32 (nonlinear carries —
+        a slightly better multiset structure than the round-4 XOR, which
+        was linear over GF(2); slots hold distinct keys by construction
+        either way, so neither combine can cancel duplicates)."""
         if not self._msg_word_sls:
-            return jnp.zeros(v.shape[:-1], jnp.uint64)
+            z = jnp.zeros(v.shape[:-1], jnp.uint32)
+            return z, z
         words = [v[..., sl] for sl in self._msg_word_sls]  # each [B, M]
         cnt = v[..., self._msg_cnt_sl]
         occ = words[0] != EMPTY
-        h = jnp.zeros_like(words[0], dtype=jnp.uint64)
+        ha = jnp.zeros_like(words[0], dtype=jnp.uint32)
+        hb = jnp.zeros_like(words[0], dtype=jnp.uint32)
         for w_i, w in enumerate([*words, cnt]):
-            x = w.astype(jnp.uint64)
+            x = w.astype(jnp.uint32)
             if self.seed:
-                x = x ^ np.uint64(
-                    _host_mix64(w_i * int(_C2) + self.seed)
-                )
-            h = h ^ mix64(x * _C1 + np.uint64((w_i * int(_C2)) & _MASK64))
-        h = mix64(h)
-        return jnp.bitwise_xor.reduce(
-            jnp.where(occ, h, jnp.uint64(0)), axis=-1
-        )
+                sw = _host_mix64(w_i * int(_C2) + self.seed)
+                x = x ^ np.uint32(sw & 0xFFFFFFFF)
+            wa, wb = _salt(w_i, 20)
+            ha = ha ^ mix32(x * KA + wa)
+            hb = hb ^ mix32(x * KB + wb)
+        # per-slot finalize, then a single stacked multiset-sum reduce
+        ha = mix32(ha + KB)
+        hb = mix32(hb + KA)
+        return _psum_last(_pwhere(occ, (ha, hb)))
 
     def _perm_hash(self, v):
         """u64 hash of a permuted [B, VL] view: positional over the
-        non-bag lanes XOR the slot-order-free bag multiset hash."""
-        nb = hash_lanes(v[..., self._nonbag_lanes], seed=self.seed)
-        return mix64(nb ^ self._bag_hash(v))
+        non-bag lanes XOR the slot-order-free bag multiset hash (all
+        mixing in u32 stream pairs; one u64 combine at the end)."""
+        na, nb = hash_lanes_pair(v[..., self._nonbag_lanes], seed=self.seed)
+        ba, bb = self._bag_hash_pair(v)
+        return combine_pair(na ^ ba, nb ^ bb)
 
     # ---------------- equivariant per-server signatures ----------------
 
@@ -329,14 +395,12 @@ class Canonicalizer:
         sig(perm(x))[sigma(i)] == sig(x)[i]. Built from per-server
         invariant content plus one 1-WL refinement round; every fold is
         either self-relative or an unordered multiset sum, and no fold
-        reads a raw server index."""
+        reads a raw server index. All mixing runs as u32 stream pairs
+        (v4 — u64 multiplies are ~400x slow on this TPU, hashing.py);
+        the streams combine into one orderable u64 at the very end."""
         S, B = self.S, view.shape[0]
-        u64 = jnp.uint64
         srange = jnp.arange(S, dtype=jnp.int32)
-        acc = jnp.zeros((B, S), u64)
-
-        def m(x, salt):
-            return mix64(x.astype(u64) * _C1 + salt)
+        acc = (jnp.zeros((B, S), jnp.uint32), jnp.zeros((B, S), jnp.uint32))
 
         # ---- round 0: invariant content ----
         val_fields = []  # (offset, vals [B,S]) for refinement
@@ -347,37 +411,41 @@ class Canonicalizer:
             if kind == "per_server":
                 rest = size // S
                 rows = seg.reshape(B, S, rest)
-                acc = acc + m(hash_lanes(rows), _salt(off, 0))
+                acc = _padd(acc, _pfold(hash_lanes_pair(rows), _salt(off, 0)))
             elif kind == "per_server_val":
                 vals = seg  # [B, S], 0 = Nil, i+1 = server i
                 cat = jnp.where(
                     vals == 0, 0, jnp.where(vals - 1 == srange, 1, 2)
                 )
-                acc = acc + m(cat, _salt(off, 1))
+                acc = _padd(acc, _pmix(cat, _salt(off, 1)))
                 indeg = jnp.sum(
                     (vals[:, :, None] - 1 == srange[None, None, :])
                     & (vals[:, :, None] > 0),
                     axis=1,
                 )
-                acc = acc + m(indeg, _salt(off, 2))
+                acc = _padd(acc, _pmix(indeg, _salt(off, 2)))
                 val_fields.append((off, vals))
             elif kind == "server_bitmask":
                 masks = seg  # [B, S]
                 bits = (masks[:, :, None] >> srange[None, None, :]) & 1  # [B,S,S]
                 selfbit = (masks >> srange) & 1
                 pop = jnp.sum(bits, axis=2)
-                acc = acc + m(pop * 2 + selfbit, _salt(off, 3))
-                acc = acc + m(jnp.sum(bits, axis=1), _salt(off, 4))  # indeg
+                acc = _padd(acc, _pmix(pop * 2 + selfbit, _salt(off, 3)))
+                acc = _padd(acc, _pmix(jnp.sum(bits, axis=1), _salt(off, 4)))
                 bm_fields.append((off, masks))
             elif kind == "per_server_pair":
                 mat = seg.reshape(B, S, S)
                 diag = mat[:, srange, srange]
-                acc = acc + m(diag, _salt(off, 5))
-                e_row = m(mat, _salt(off, 6))
-                e_col = m(mat, _salt(off, 7))
-                offd = (srange[:, None] != srange[None, :]).astype(u64)
-                acc = acc + jnp.sum(e_row * offd, axis=2)
-                acc = acc + jnp.sum(e_col * offd, axis=1)
+                acc = _padd(acc, _pmix(diag, _salt(off, 5)))
+                offd = srange[:, None] != srange[None, :]
+                e_row = _pwhere(offd, _pmix(mat, _salt(off, 6)))
+                acc = _padd(acc, _psum_last(e_row))
+                # column fold: transpose so the multiset sum is over the
+                # LAST axis (single stacked reduce, hashing.py cliff note)
+                e_col = _pwhere(
+                    offd, _pmix(mat.transpose(0, 2, 1), _salt(off, 7))
+                )
+                acc = _padd(acc, _psum_last(e_col))
                 pair_fields.append((off, mat))
             # scalar / msg_* handled below; aux excluded by view
 
@@ -393,90 +461,126 @@ class Canonicalizer:
                 zwords = self._replace_key(
                     zwords, fname, jnp.zeros_like(zwords[0])
                 )
-            rec0 = jnp.zeros_like(words[0], dtype=u64)
+            r0a = jnp.zeros_like(words[0], dtype=jnp.uint32)
+            r0b = jnp.zeros_like(words[0], dtype=jnp.uint32)
             for w_i, w in enumerate([*zwords, cnt]):
-                rec0 = rec0 ^ mix64(
-                    w.astype(u64) * _C1
-                    + np.uint64((w_i * int(_C2)) & _MASK64)
-                )
-            rec0 = mix64(rec0)
-            cnt64 = jnp.where(occ, cnt, 0).astype(u64)
-            msg = (words, cnt64, occ, rec0)
+                x = w.astype(jnp.uint32)
+                wa, wb = _salt(w_i, 21)
+                r0a = r0a ^ mix32(x * KA + wa)
+                r0b = r0b ^ mix32(x * KB + wb)
+            rec0 = (mix32(r0a), mix32(r0b))
+            cnt32 = jnp.where(occ, cnt, 0).astype(jnp.uint32)
+            msg = (words, cnt32, occ, rec0)
             for k, (fname, kind) in enumerate(self.msg_perm_spec):
                 val = self._unpack_key(words, fname)  # [B, M]
-                c = cnt64 * m(rec0, _salt(k, 8))  # [B, M]
-                acc = acc + self._scatter_by_server(c, val, kind, occ)
+                ck = _pfold(rec0, _salt(k, 8))
+                c = (cnt32 * ck[0], cnt32 * ck[1])  # [B, M]
+                acc = _padd(acc, self._scatter_by_server(c, val, kind, occ))
 
-        sig0 = mix64(acc)
+        sig0 = (mix32(acc[0]), mix32(acc[1]))
 
         # ---- refinement: fold neighbor signatures ----
-        acc1 = jnp.zeros((B, S), u64)
+        acc1 = (jnp.zeros((B, S), jnp.uint32), jnp.zeros((B, S), jnp.uint32))
         for off, vals in val_fields:
             tgt = jnp.clip(vals - 1, 0, S - 1)
-            nsig = jnp.take_along_axis(sig0, tgt, axis=1)
+            nsig = _pgather(sig0, tgt)
             valid = (vals > 0) & (vals - 1 != srange)
-            acc1 = acc1 + jnp.where(valid, mix64(nsig ^ _salt(off, 9)), 0)
+            sa, sb = _salt(off, 9)
+            acc1 = _padd(
+                acc1,
+                _pwhere(valid, (mix32(nsig[0] ^ sa), mix32(nsig[1] ^ sb))),
+            )
         for off, masks in bm_fields:
-            bits = ((masks[:, :, None] >> srange[None, None, :]) & 1).astype(u64)
-            e = mix64(sig0 ^ _salt(off, 10))  # [B, S]
-            acc1 = acc1 + jnp.sum(bits * e[:, None, :], axis=2)
+            bits = ((masks[:, :, None] >> srange[None, None, :]) & 1) == 1
+            sa, sb = _salt(off, 10)
+            e = (mix32(sig0[0] ^ sa), mix32(sig0[1] ^ sb))  # [B, S]
+            contrib = _pwhere(
+                bits,
+                (
+                    jnp.broadcast_to(e[0][:, None, :], bits.shape),
+                    jnp.broadcast_to(e[1][:, None, :], bits.shape),
+                ),
+            )
+            acc1 = _padd(acc1, _psum_last(contrib))
         for off, mat in pair_fields:
-            er = mix64(mat.astype(u64) * _C1 + (sig0 ^ _salt(off, 11))[:, None, :])
-            acc1 = acc1 + jnp.sum(er, axis=2)
-            ec = mix64(mat.astype(u64) * _C1 + (sig0 ^ _salt(off, 12))[:, :, None])
-            acc1 = acc1 + jnp.sum(ec, axis=1)
+            sa, sb = _salt(off, 11)
+            m32 = mat.astype(jnp.uint32)
+            era = mix32(m32 * KA + (sig0[0] ^ sa)[:, None, :])
+            erb = mix32(m32 * KB + (sig0[1] ^ sb)[:, None, :])
+            acc1 = _padd(acc1, _psum_last((era, erb)))
+            sa2, sb2 = _salt(off, 12)
+            mt32 = mat.transpose(0, 2, 1).astype(jnp.uint32)
+            eca = mix32(mt32 * KA + (sig0[0] ^ sa2)[:, None, :])
+            ecb = mix32(mt32 * KB + (sig0[1] ^ sb2)[:, None, :])
+            acc1 = _padd(acc1, _psum_last((eca, ecb)))
         if msg is not None:
-            words, cnt64, occ, rec0 = msg
+            words, cnt32, occ, rec0 = msg
             # per-slot fold of every referenced server's sig0, then
             # re-scatter: binds a record's endpoints together
             svals = []
-            osum = jnp.zeros_like(rec0)
+            osum = (jnp.zeros_like(rec0[0]), jnp.zeros_like(rec0[1]))
             for k, (fname, kind) in enumerate(self.msg_perm_spec):
                 val = self._unpack_key(words, fname)
                 svals.append(val)
-                osum = osum + self._gather_sig_fold(sig0, val, kind, _salt(k, 13))
+                osum = _padd(
+                    osum, self._gather_sig_fold(sig0, val, kind, _salt(k, 13))
+                )
             for k, (fname, kind) in enumerate(self.msg_perm_spec):
                 # exclude the target's own contribution so its fold is
                 # over the OTHER endpoints
                 own = self._gather_sig_fold(sig0, svals[k], kind, _salt(k, 13))
-                c = cnt64 * mix64(rec0 + (osum - own) + _salt(k, 14))
-                acc1 = acc1 + self._scatter_by_server(c, svals[k], kind, occ)
+                sa, sb = _salt(k, 14)
+                c = (
+                    cnt32 * mix32(rec0[0] + (osum[0] - own[0]) + sa),
+                    cnt32 * mix32(rec0[1] + (osum[1] - own[1]) + sb),
+                )
+                acc1 = _padd(acc1, self._scatter_by_server(c, svals[k], kind, occ))
 
-        return mix64(sig0 + mix64(acc1))
+        fa = mix32(sig0[0] + mix32(acc1[0]))
+        fb = mix32(sig0[1] + mix32(acc1[1]))
+        return combine_pair(fa, fb)
 
     def _scatter_by_server(self, contrib, val, kind, occ):
-        """Sum [B, M] contributions onto the servers referenced by a
-        message field ([B, M] values, interpretation per kind) -> [B, S]."""
+        """Sum [B, M] stream-pair contributions onto the servers
+        referenced by a message field ([B, M] values, interpretation per
+        kind) -> [B, S] pair. Laid out [B, S, M] so the multiset sum is a
+        single stacked last-axis reduce."""
         S = self.S
         srange = jnp.arange(S, dtype=jnp.int32)
-        c = jnp.where(occ, contrib, 0)
+        ca = jnp.where(occ, contrib[0], 0)
+        cb = jnp.where(occ, contrib[1], 0)
+        vt = val[:, None, :]  # [B, 1, M]
         if kind == "server":
-            onehot = (val[:, :, None] == srange[None, None, :])
+            onehot = vt == srange[None, :, None]
         elif kind == "server_nil":
-            onehot = (val[:, :, None] - 1 == srange[None, None, :]) & (
-                val[:, :, None] > 0
-            )
+            onehot = (vt - 1 == srange[None, :, None]) & (vt > 0)
         elif kind == "server_bitmask":
-            onehot = ((val[:, :, None] >> srange[None, None, :]) & 1) == 1
+            onehot = ((vt >> srange[None, :, None]) & 1) == 1
         else:
             raise ValueError(f"unknown msg perm kind {kind}")
-        return jnp.sum(jnp.where(onehot, c[:, :, None], 0), axis=1)
+        pa = jnp.where(onehot, ca[:, None, :], 0)
+        pb = jnp.where(onehot, cb[:, None, :], 0)
+        return _psum_last((pa, pb))
 
     def _gather_sig_fold(self, sig0, val, kind, salt):
         """Fold the sig0 of servers referenced by a [B, M] message field
-        into a per-slot u64 (multiset sum; 0 when Nil/absent)."""
+        into a per-slot stream pair (multiset sum; 0 when Nil/absent)."""
         S = self.S
+        sa, sb = salt
         if kind == "server":
-            nsig = jnp.take_along_axis(sig0, jnp.clip(val, 0, S - 1), axis=1)
-            return mix64(nsig ^ salt)
+            nsig = _pgather(sig0, jnp.clip(val, 0, S - 1))
+            return mix32(nsig[0] ^ sa), mix32(nsig[1] ^ sb)
         if kind == "server_nil":
-            nsig = jnp.take_along_axis(sig0, jnp.clip(val - 1, 0, S - 1), axis=1)
-            return jnp.where(val > 0, mix64(nsig ^ salt), 0)
+            nsig = _pgather(sig0, jnp.clip(val - 1, 0, S - 1))
+            return _pwhere(val > 0, (mix32(nsig[0] ^ sa), mix32(nsig[1] ^ sb)))
         if kind == "server_bitmask":
             srange = jnp.arange(S, dtype=jnp.int32)
-            bits = ((val[:, :, None] >> srange[None, None, :]) & 1).astype(jnp.uint64)
-            e = mix64(sig0 ^ salt)  # [B, S]
-            return jnp.sum(bits * e[:, None, :], axis=2)
+            bits = ((val[:, :, None] >> srange[None, None, :]) & 1) == 1
+            ea = mix32(sig0[0] ^ sa)  # [B, S]
+            eb = mix32(sig0[1] ^ sb)
+            pa = jnp.where(bits, jnp.broadcast_to(ea[:, None, :], bits.shape), 0)
+            pb = jnp.where(bits, jnp.broadcast_to(eb[:, None, :], bits.shape), 0)
+            return _psum_last((pa, pb))
         raise ValueError(f"unknown msg perm kind {kind}")
 
     # ---------------- applying a permutation ----------------
@@ -591,7 +695,7 @@ class Canonicalizer:
         if sig is None:  # unpruned: every permutation admissible
             return h
         ssig = sig[:, inv_p]
-        adm = jnp.all(ssig[:, 1:] >= ssig[:, :-1], axis=1)
+        adm = jnp.all(ge_u64(ssig[:, 1:], ssig[:, :-1]), axis=1)
         return jnp.where(adm, h, U64_MAX)
 
     def _masked_min(self, view, sig):
@@ -659,7 +763,7 @@ class Canonicalizer:
         # ---- tier 1: one dynamic permutation (the signature argsort) ----
         order = jnp.argsort(sig, axis=1).astype(jnp.int32)  # = inv
         ssig = jnp.take_along_axis(sig, order, axis=1)
-        adj_eq = ssig[:, 1:] == ssig[:, :-1]  # [B, S-1]
+        adj_eq = eq_u64(ssig[:, 1:], ssig[:, :-1])  # [B, S-1]
         sigma = jnp.argsort(order, axis=1).astype(jnp.int32)
         v0 = jnp.take_along_axis(view, self._dyn_gidx(order), axis=1)
         v0 = self._apply_sigma_values(v0, sigma)

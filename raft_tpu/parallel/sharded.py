@@ -54,7 +54,9 @@ from ..checker.lsm import RunLSM, pow2_at_least
 from ..checker.util import (
     GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
 )
-from ..ops.hashing import U64_MAX
+from ..ops.hashing import (
+    U64_MAX, eq_u64, ne_u64, sort_u64, sort_u64_with_idx, split_u64,
+)
 from ..ops.symmetry import Canonicalizer
 
 AXIS = "shards"
@@ -113,6 +115,8 @@ class ShardedBFS:
         self.invariants = tuple(invariants)
         devices = devices if devices is not None else jax.devices()
         self.D = len(devices)
+        # the u32-decomposed fp%D owner routing is exact only for D<=2^16
+        assert self.D <= (1 << 16), "owner routing supports at most 2^16 shards"
         self.mesh = Mesh(np.array(devices), (AXIS,))
         self.chunk = chunk
         self.A = model.A
@@ -249,8 +253,15 @@ class ShardedBFS:
         payload = jnp.concatenate(
             [flatc, parent_lgid[:, None], cand[:, None]], axis=1
         )  # [VC, W+2] i32
-        owner = (fps % np.uint64(D)).astype(jnp.int32)
-        owner = jnp.where(fps == U64_MAX, D, owner)  # invalid -> drop
+        # fp mod D in u32 pieces (u64 div/mod lanes are slow on this TPU):
+        # (hi*2^32 + lo) % D == ((hi%D) * (2^32%D) + lo%D) % D
+        # exact only while (D-1)*(2^32%D) + (D-1) fits u32 — enforced at
+        # construction (D <= 2^16), and real meshes are far smaller
+        fhi, flo = split_u64(fps)
+        t32 = np.uint32((1 << 32) % D)
+        owner = (((fhi % np.uint32(D)) * t32 + flo % np.uint32(D))
+                 % np.uint32(D)).astype(jnp.int32)
+        owner = jnp.where(eq_u64(fps, U64_MAX), D, owner)  # invalid -> drop
         order = jnp.argsort(owner, stable=True)
         owner_s = owner[order]
         fps_s = fps[order]
@@ -270,10 +281,9 @@ class ShardedBFS:
         recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
 
         # 6. local dedup: probe the occupied LSM runs + first-occurrence
-        sidx = jnp.argsort(recv_fps, stable=True)
-        rf = recv_fps[sidx]
-        uniq = jnp.ones_like(rf, dtype=bool).at[1:].set(rf[1:] != rf[:-1])
-        fresh = uniq & (rf != U64_MAX)
+        rf, sidx = sort_u64_with_idx(recv_fps)
+        uniq = jnp.ones_like(rf, dtype=bool).at[1:].set(ne_u64(rf[1:], rf[:-1]))
+        fresh = uniq & ne_u64(rf, U64_MAX)
         for i, r in enumerate(runs):
             hit = lax.cond(
                 occ[i],
@@ -302,7 +312,7 @@ class ShardedBFS:
         jpl = jpl.at[jdst].set(recv_pay[sidx, W])
         jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
         # the chip's new fps as one sorted run (LSM level-0 insert)
-        new_run = jnp.sort(jnp.where(new, rf, U64_MAX))
+        new_run = sort_u64(jnp.where(new, rf, U64_MAX))
         DRC = new_run.shape[0]
         if self.R0 > DRC:
             new_run = jnp.concatenate(
@@ -376,7 +386,7 @@ class ShardedBFS:
     def _ckpt_ident(self) -> str:
         return (
             f"sharded/{self.model.name}/{self.model.p}/W={self.W}"
-            f"/D={self.D}/sym={self.canon.symmetry}/hashv=3"
+            f"/D={self.D}/sym={self.canon.symmetry}/hashv=4"
             f"/inv={','.join(self.invariants)}"
         )
 

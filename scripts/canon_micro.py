@@ -1,0 +1,154 @@
+"""Micro-profile of canonicalization sub-stages at bench geometry.
+
+Fresh-process timings (the tunnel's long-process dispatch floor distorts
+stage sums — see bench.py); run as its own process per workload:
+
+    python scripts/canon_micro.py [raft3|raft5]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=5):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else None
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "raft3"
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+    if which == "raft5":
+        cfg.constants["Server"] = ["n1", "n2", "n3", "n4", "n5"]
+    setup = build_from_cfg(cfg, msg_slots=32)
+    model = setup.model
+    canon = __import__(
+        "raft_tpu.ops.symmetry", fromlist=["Canonicalizer"]
+    ).Canonicalizer.for_model(model, symmetry=True)
+
+    B = 65536
+    # realistic-ish states: expand init a few waves on CPU-ish path is slow;
+    # just tile init states with random aux jitter in valid ranges is risky.
+    # Use real successors: expand init states via model._expand1 a few rounds.
+    states = np.asarray(model.init_states())
+    rng = np.random.default_rng(0)
+    exp = jax.jit(jax.vmap(model._expand1))
+    for _ in range(6):
+        succs, valid, _r, _o = jax.device_get(exp(jnp.asarray(states)))
+        flat = succs.reshape(-1, succs.shape[-1])[valid.reshape(-1)]
+        if len(flat) > B:
+            flat = flat[rng.choice(len(flat), B, replace=False)]
+        states = flat
+    reps = int(np.ceil(B / len(states)))
+    states = np.tile(states, (reps, 1))[:B]
+    view = jnp.asarray(states[:, : canon.VL])
+    print(f"{which}: S={canon.S} P={canon.P} VL={canon.VL} "
+          f"nonbag={len(canon._nonbag_lanes)} B={B}", flush=True)
+
+    full = jax.jit(canon._fingerprints)
+    t = timeit(full, jnp.asarray(states))
+    print(f"fingerprints_total: {t*1e3:.1f} ms", flush=True)
+
+    if canon.prune:
+        sig = jax.jit(canon._signatures)
+        t = timeit(sig, view)
+        print(f"signatures: {t*1e3:.1f} ms", flush=True)
+
+    mm = jax.jit(lambda v: canon._masked_min(v, None))
+    t = timeit(mm, view)
+    print(f"masked_min_full_table (P={canon.P}): {t*1e3:.1f} ms", flush=True)
+
+    # sub-stages of one static perm, x P to compare
+    gi0 = canon._gidx
+    P = canon.P
+
+    @jax.jit
+    def gathers_only(v):
+        acc = jnp.zeros((v.shape[0],), jnp.uint64)
+        for p in range(P):
+            acc = acc ^ v[:, gi0[p]].astype(jnp.uint64).sum(axis=1)
+        return acc
+
+    t = timeit(gathers_only, view)
+    print(f"row-gathers xP only: {t*1e3:.1f} ms", flush=True)
+
+    @jax.jit
+    def hash_only(v):
+        acc = jnp.zeros((v.shape[0],), jnp.uint64)
+        for _p in range(P):
+            acc = acc ^ canon._perm_hash(v)
+        return acc
+
+    t = timeit(hash_only, view)
+    print(f"perm_hash xP (no gather/remap): {t*1e3:.1f} ms", flush=True)
+
+    @jax.jit
+    def bag_only(v):
+        acc = jnp.zeros((v.shape[0],), jnp.uint64)
+        for _p in range(P):
+            acc = acc ^ canon._bag_hash(v)
+        return acc
+
+    t = timeit(bag_only, view)
+    print(f"bag_hash xP: {t*1e3:.1f} ms", flush=True)
+
+    from raft_tpu.ops.hashing import hash_lanes
+
+    @jax.jit
+    def nb_only(v):
+        acc = jnp.zeros((v.shape[0],), jnp.uint64)
+        for _p in range(P):
+            acc = acc ^ hash_lanes(v[:, canon._nonbag_lanes])
+        return acc
+
+    t = timeit(nb_only, view)
+    print(f"nonbag hash_lanes xP: {t*1e3:.1f} ms", flush=True)
+
+    # remap-only (value remaps w/o gather or hash)
+    vm, p2, sg = canon._valmap, canon._pow2sig, canon._sigma
+
+    @jax.jit
+    def remap_only(v):
+        acc = jnp.zeros((v.shape[0],), jnp.int32)
+        for p in range(P):
+            vv = v
+            if canon._val_lanes.size:
+                vl = vv[:, canon._val_lanes]
+                vv = vv.at[:, canon._val_lanes].set(vm[p][vl])
+            if canon._msg_word_sls:
+                words = [vv[:, sl] for sl in canon._msg_word_sls]
+                nwords = list(words)
+                for fname, kind in canon.msg_perm_spec:
+                    val = canon._unpack_key(nwords, fname)
+                    if kind == "server":
+                        mapped = sg[p][jnp.clip(val, 0, canon.S - 1)]
+                    else:
+                        mapped = val
+                    nwords = canon._replace_key(nwords, fname, mapped)
+                for sl, arr in zip(canon._msg_word_sls, nwords):
+                    vv = vv.at[:, sl].set(arr)
+            acc = acc ^ vv.sum(axis=1)
+        return acc
+
+    t = timeit(remap_only, view)
+    print(f"value remaps xP (incl .at[].set): {t*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Compare u64 splitmix hashing vs u32-pair hashing at chunk geometry."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+B, K, P = 65536, 190, 6
+rng = np.random.default_rng(0)
+v = jnp.asarray(rng.integers(0, 1 << 30, (B, K), dtype=np.int32))
+
+from raft_tpu.ops.hashing import mix64, _C1, _C2
+
+M1 = np.uint32(0x85EBCA6B)
+M2 = np.uint32(0xC2B2AE35)
+
+
+def mix32(z):
+    z = (z ^ (z >> np.uint32(16))) * M1
+    z = (z ^ (z >> np.uint32(13))) * M2
+    return z ^ (z >> np.uint32(16))
+
+
+@jax.jit
+def h64(v):
+    acc = jnp.zeros((B,), jnp.uint64)
+    pos = jnp.arange(K, dtype=jnp.uint64)
+    for p in range(P):
+        x = v.astype(jnp.uint64)
+        h = mix64(x * _C1 + pos * _C2 + np.uint64(p * 1234567))
+        acc = acc ^ jnp.bitwise_xor.reduce(h, axis=-1)
+    return acc
+
+
+@jax.jit
+def h32pair(v):
+    accA = jnp.zeros((B,), jnp.uint32)
+    accB = jnp.zeros((B,), jnp.uint32)
+    posA = jnp.arange(K, dtype=jnp.uint32) * np.uint32(0x9E3779B9)
+    posB = jnp.arange(K, dtype=jnp.uint32) * np.uint32(0x7FEB352D)
+    for p in range(P):
+        x = v.astype(jnp.uint32)
+        hA = mix32(x * np.uint32(0xCC9E2D51) + posA + np.uint32(p * 77))
+        hB = mix32(x * np.uint32(0x1B873593) + posB + np.uint32(p * 101))
+        accA = accA ^ jnp.bitwise_xor.reduce(hA, axis=-1)
+        accB = accB ^ jnp.bitwise_xor.reduce(hB, axis=-1)
+    return accA.astype(jnp.uint64) << np.uint64(32) | accB.astype(jnp.uint64)
+
+
+t = timeit(h64, v)
+print(f"u64 hash xP={P}: {t*1e3:.3f} ms", jax.device_get(h64(v))[0])
+t = timeit(h32pair, v)
+print(f"u32-pair hash xP={P}: {t*1e3:.3f} ms", jax.device_get(h32pair(v))[0])
