@@ -77,6 +77,8 @@ from jax import lax
 from .hashing import (
     KA,
     KB,
+    PA,
+    PB,
     U64_MAX,
     _reduce_pair,
     combine_pair,
@@ -84,6 +86,7 @@ from .hashing import (
     ge_u64,
     hash_lanes_pair,
     mix32,
+    seed_salts,
 )
 from .packing import EMPTY, BitPacker, WidePacker
 from ..models.base import Layout
@@ -99,6 +102,16 @@ def _host_mix64(z: int) -> int:
     z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
     z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
     return z ^ (z >> 31)
+
+
+def _np_mix32(z: np.ndarray) -> np.ndarray:
+    """murmur3 fmix32 on numpy arrays (u64 intermediate, masked) — for
+    building static seed-family xor-mask tables at construction time."""
+    m = 0xFFFFFFFF
+    z = z.astype(np.uint64) & m
+    z = ((z ^ (z >> 16)) * 0x85EBCA6B) & m
+    z = ((z ^ (z >> 13)) * 0xC2B2AE35) & m
+    return (z ^ (z >> 16)).astype(np.uint32)
 
 
 def _salt(field_offset: int, role: int) -> tuple[np.uint32, np.uint32]:
@@ -287,12 +300,6 @@ class Canonicalizer:
         self._msg_cnt_sl = msg_cnt_sl
         self._view_fields = sorted(view_fields, key=lambda t: t[1])
         assert sum(t[3] for t in self._view_fields) == VL, "view lane gap"
-        # static per-permutation tables for the masked-min path (the
-        # tier-2 tables below come from the same builder, so the
-        # permutation action lives in exactly one place)
-        (self._gidx, self._sigma,
-         self._valmap, self._pow2sig) = self._build_tables(perms)
-        self._inv_sigma = jnp.asarray(np.argsort(perms, axis=1).astype(np.int32))
         # non-bag view lanes for the positional half of the hash
         bag_lanes: set[int] = set()
         for sl in msg_word_sls:
@@ -302,24 +309,67 @@ class Canonicalizer:
         self._nonbag_lanes = np.array(
             [i for i in range(VL) if i not in bag_lanes], dtype=np.int32
         )
+        # ---- direct-hash structure (round 5): the permuted view is
+        # never materialized. The positional hash of a permuted view is
+        # the lane-wise XOR of mix32(value * K + position*P) — so a lane
+        # permutation is just a permutation of the POSITIONAL SALTS
+        # (precomputed numpy tables for static permutation sets; cheap
+        # elementwise arithmetic for the dynamic tier-1 argsort), and a
+        # value remap is a one-hot select over <= S+1 values. Non-bag
+        # lanes split into three groups by how values transform:
+        #   plain  value invariant (per_server rows, pair matrices,
+        #          scalars) — only the position moves
+        #   val    server-valued lanes (0 = Nil, i+1 = server i)
+        #   bm     bitmask lanes (member sets over servers)
+        # XOR-combining the three group reduces equals the single
+        # all-lanes reduce of hash_lanes_pair (XOR is commutative and
+        # the salt carries the position), so fingerprints are
+        # BIT-IDENTICAL to the round-4 v4 formula (hashv stays 4).
+        nb = self._nonbag_lanes
+        self._K_nb = len(nb)
+        nb_inv = np.full(VL, -1, dtype=np.int64)
+        nb_inv[nb] = np.arange(len(nb))
+        self._nb_inv = nb_inv
+        vset, bset = set(val_lanes), set(bm_lanes)
+        self._ln_plain = np.array(
+            [l for l in nb if l not in vset and l not in bset], np.int32
+        )
+        self._ln_val = np.array([l for l in nb if l in vset], np.int32)
+        self._ln_bm = np.array([l for l in nb if l in bset], np.int32)
+        # dynamic-permutation segment recipe, per group in lane order
+        # (view_fields are offset-sorted, so per-group concatenation
+        # matches the _ln_* lane order)
+        self._dyn_segs: list[tuple[str, str, int, int]] = []
+        for kind, off, shape, size in self._view_fields:
+            if kind in ("msg_hi", "msg_lo", "msg_word", "msg_cnt"):
+                continue
+            nbbase = int(nb_inv[off])
+            if kind in ("per_server", "per_server_val", "server_bitmask"):
+                group = {"per_server_val": "val",
+                         "server_bitmask": "bm"}.get(kind, "plain")
+                self._dyn_segs.append((group, "rows", nbbase, size // S))
+            elif kind == "per_server_pair":
+                self._dyn_segs.append(("plain", "pair", nbbase, S))
+            else:
+                self._dyn_segs.append(("plain", "static", nbbase, size))
+        if symmetry:
+            self._dt_full = self._build_direct(perms)
         if self.prune:
             # tier-2 static tables: all non-identity products of DISJOINT
-            # adjacent transpositions (7 at S=5; the identity is tier 1's
-            # argsort). Applied to the signature-
+            # adjacent transpositions (7 non-identity products at S=5;
+            # tier 1's argsort is the identity on the sorted view).
+            # Applied to the signature-
             # SORTED view these are exactly the block permutations of any
             # tie pattern whose groups have size <= 2 — measured to be
             # >98% of tied states past depth ~9 on the 5-server workload
             # (the rest fall to the masked full-S! path).
             tperms, tmask = _adj_swap_products(S)
-            tg, tsg, tvm, tp2 = self._build_tables(tperms)
-            self._t_gidx, self._t_sigma = tg, tsg
-            self._t_valmap, self._t_pow2 = tvm, tp2
+            self._t_sigma = jnp.asarray(tperms)  # [T, S] for composition
             self._t_edge_mask = jnp.asarray(tmask)  # [T, S-1]
         self.fingerprints = jax.jit(self._fingerprints)
 
-    def _build_tables(self, perms: np.ndarray):
-        """Static per-permutation tables (lane gather, sigma, value remap,
-        bitmask remap) for an arbitrary [T, S] permutation set."""
+    def _np_gidx(self, perms: np.ndarray) -> np.ndarray:
+        """[T, VL] lane-gather table: permuted[l] = view[gidx[t, l]]."""
         S, VL = self.S, self.VL
         T = perms.shape[0]
         inv = np.argsort(perms, axis=1).astype(np.int32)
@@ -332,11 +382,46 @@ class Canonicalizer:
             elif kind == "per_server_pair":
                 src = off + inv[:, :, None] * S + inv[:, None, :]
                 gidx[:, off : off + size] = src.reshape(T, -1)
+        return gidx
+
+    def _build_direct(self, perms: np.ndarray) -> dict:
+        """Direct-hash tables for a static [T, S] permutation set: per
+        nonbag GROUP, u32 positional-salt tables (and seed-family xor
+        masks), plus value-remap tables and the inverse permutations for
+        the admissibility mask. All numpy at build time; jnp constants."""
+        S = self.S
+        T = perms.shape[0]
+        nb = self._nonbag_lanes
+        K = self._K_nb
+        gidx = self._np_gidx(perms)
+        # outpos[t, j] = hash position (index within the nonbag subset of
+        # the PERMUTED view) that source nonbag lane j lands at
+        src = self._nb_inv[gidx[:, nb]]  # [T, K] src nonbag idx per outpos
+        outpos = np.empty((T, K), dtype=np.int64)
+        rows = np.repeat(np.arange(T), K)
+        outpos[rows, src.reshape(-1)] = np.tile(np.arange(K), T)
+        dt: dict = {
+            "perms": jnp.asarray(perms.astype(np.int32)),
+            "inv": jnp.asarray(np.argsort(perms, axis=1).astype(np.int32)),
+            "pow2": jnp.asarray((1 << perms).astype(np.int32)),
+        }
         valmap = np.zeros((T, S + 1), dtype=np.int32)
         valmap[:, 1:] = perms + 1
-        pow2 = (1 << perms).astype(np.int32)
-        return (jnp.asarray(gidx), jnp.asarray(perms),
-                jnp.asarray(valmap), jnp.asarray(pow2))
+        dt["valmap"] = jnp.asarray(valmap)
+        if self.seed:
+            sa, sb = seed_salts(self.seed)
+        for gname, lanes in (("plain", self._ln_plain),
+                             ("val", self._ln_val), ("bm", self._ln_bm)):
+            kpos = self._nb_inv[lanes]  # this group's nonbag indices
+            op = outpos[:, kpos] if len(lanes) else outpos[:, :0]
+            pa = ((op * int(PA)) & 0xFFFFFFFF).astype(np.uint32)
+            pb = ((op * int(PB)) & 0xFFFFFFFF).astype(np.uint32)
+            dt[f"pa_{gname}"] = jnp.asarray(pa)
+            dt[f"pb_{gname}"] = jnp.asarray(pb)
+            if self.seed:
+                dt[f"xa_{gname}"] = jnp.asarray(_np_mix32(pa + sa))
+                dt[f"xb_{gname}"] = jnp.asarray(_np_mix32(pb + sb))
+        return dt
 
     # packer adapters: BitPacker works on (hi, lo), WidePacker on tuples
     def _unpack_key(self, words, name):
@@ -583,133 +668,241 @@ class Canonicalizer:
             return _psum_last((pa, pb))
         raise ValueError(f"unknown msg perm kind {kind}")
 
-    # ---------------- applying a permutation ----------------
+    # ------------- direct permuted hashing (no materialization) -------------
 
-    def _dyn_gidx(self, inv):
-        """Per-state lane gather indices from [B, S] inverse perms (new
-        row k takes old row inv[k]) -> [B, VL]."""
-        B = inv.shape[0]
-        S = self.S
-        segs = []
-        for kind, off, shape, size in self._view_fields:
-            if kind in ("per_server", "per_server_val", "server_bitmask"):
-                rest = size // S
-                idx = (
-                    off
-                    + inv[:, :, None] * rest
-                    + jnp.arange(rest, dtype=jnp.int32)[None, None, :]
-                )
-                segs.append(idx.reshape(B, size))
-            elif kind == "per_server_pair":
-                idx = off + inv[:, :, None] * S + inv[:, None, :]
-                segs.append(idx.reshape(B, size))
-            else:
-                ident = jnp.arange(off, off + size, dtype=jnp.int32)
-                segs.append(jnp.broadcast_to(ident[None, :], (B, size)))
-        return jnp.concatenate(segs, axis=1)
+    def _group_stream(self, vals, pa, pb, xa_m, xb_m):
+        """XOR-reduced (u32, u32) stream pair of one lane group: vals
+        int32 [..., B, K] (already value-remapped), pa/pb u32 positional
+        salts (broadcastable), xa_m/xb_m the seed-family xor masks (None
+        for seed=0). One stacked reduce (hashing.py fusion-cliff note)."""
+        x = vals.astype(jnp.uint32)
+        xa = x ^ xa_m if xa_m is not None else x
+        xb = x ^ xb_m if xb_m is not None else x
+        ha = mix32(xa * KA + pa)
+        hb = mix32(xb * KB + pb)
+        return _reduce_pair(ha, hb, op="xor")
 
-    def _apply_sigma_values(self, v, sigma):
-        """Remap server-VALUED content of row-gathered [B, VL] views under
-        per-state sigma [B, S] (old server i -> new index sigma[i])."""
-        S = self.S
-        if self._val_lanes.size:
-            vl = v[:, self._val_lanes]
-            idx = jnp.clip(vl - 1, 0, S - 1)
-            mapped = jnp.take_along_axis(sigma, idx, axis=1) + 1
-            v = v.at[:, self._val_lanes].set(jnp.where(vl > 0, mapped, 0))
-        if self._bm_lanes.size:
-            x = v[:, self._bm_lanes]
-            out = jnp.zeros_like(x)
-            for j in range(S):
-                out = out | (((x >> j) & 1) << sigma[:, j : j + 1])
-            v = v.at[:, self._bm_lanes].set(out)
+    def _nb_const(self):
+        ka = np.uint32((self._K_nb * int(KA)) & 0xFFFFFFFF)
+        kb = np.uint32((self._K_nb * int(KB)) & 0xFFFFFFFF)
+        return ka, kb
+
+    def _remap_val_static(self, xv, valmap):
+        """One-hot server-value remap under [T, S+1] tables -> [T, B, Kv]."""
+        out = jnp.zeros((valmap.shape[0],) + xv.shape, jnp.int32)
+        for u in range(1, self.S + 1):  # value 0 (Nil) maps to 0
+            out = out + jnp.where(xv[None] == u, valmap[:, u, None, None], 0)
+        return out
+
+    def _remap_bm_static(self, xb, pow2):
+        """Bitmask remap under [T, S] bit-target tables -> [T, B, Kb]."""
+        out = jnp.zeros((pow2.shape[0],) + xb.shape, jnp.int32)
+        for j in range(self.S):
+            out = out + ((xb[None] >> j) & 1) * pow2[:, j, None, None]
+        return out
+
+    def _bag_streams(self, view, remap_field):
+        """Shared bag-hash skeleton: ``remap_field(val, kind)`` supplies
+        the permuted value of each server-referencing message field
+        (with any leading permutation axes); returns the multiset-summed
+        stream pair [..., B] (bit-identical to _bag_hash_pair on the
+        materialized permuted view — unoccupied slots are masked out
+        either way, so their word values never contribute)."""
+        words = [view[:, sl] for sl in self._msg_word_sls]
+        cnt = view[:, self._msg_cnt_sl]
+        occ = words[0] != EMPTY
+        nwords = list(words)  # remapped values carry any leading perm axes
+        for fname, kind in self.msg_perm_spec:
+            val = self._unpack_key(words, fname)  # [B, M], original bits
+            nwords = self._replace_key(nwords, fname, remap_field(val, kind))
+        ha = hb = jnp.uint32(0)
+        for w_i, w in enumerate([*nwords, cnt]):
+            x = w.astype(jnp.uint32)
+            if self.seed:
+                sw = _host_mix64(w_i * int(_C2) + self.seed)
+                x = x ^ np.uint32(sw & 0xFFFFFFFF)
+            wa, wb = _salt(w_i, 20)
+            ha = ha ^ mix32(x * KA + wa)
+            hb = hb ^ mix32(x * KB + wb)
+        ha = mix32(ha + KB)
+        hb = mix32(hb + KA)
+        return _psum_last(_pwhere(occ, (ha, hb)))
+
+    def _hash_static(self, view, dt):
+        """u64 [T, B] hashes of ``view`` under every permutation of a
+        static direct-table set — without materializing permuted views:
+        per group, the original values (plain) or one-hot-remapped values
+        (val/bm) mix against the PERMUTED positional-salt tables."""
+        parts = []
+        if self._ln_plain.size:
+            parts.append(self._group_stream(
+                view[:, self._ln_plain],
+                dt["pa_plain"][:, None, :], dt["pb_plain"][:, None, :],
+                dt["xa_plain"][:, None, :] if self.seed else None,
+                dt["xb_plain"][:, None, :] if self.seed else None,
+            ))
+        if self._ln_val.size:
+            vals = self._remap_val_static(view[:, self._ln_val], dt["valmap"])
+            parts.append(self._group_stream(
+                vals, dt["pa_val"][:, None, :], dt["pb_val"][:, None, :],
+                dt["xa_val"][:, None, :] if self.seed else None,
+                dt["xb_val"][:, None, :] if self.seed else None,
+            ))
+        if self._ln_bm.size:
+            vals = self._remap_bm_static(view[:, self._ln_bm], dt["pow2"])
+            parts.append(self._group_stream(
+                vals, dt["pa_bm"][:, None, :], dt["pb_bm"][:, None, :],
+                dt["xa_bm"][:, None, :] if self.seed else None,
+                dt["xb_bm"][:, None, :] if self.seed else None,
+            ))
+        ka, kb = self._nb_const()
+        na = parts[0][0]
+        nb_ = parts[0][1]
+        for a, b in parts[1:]:
+            na = na ^ a
+            nb_ = nb_ ^ b
+        na = na ^ ka
+        nb_ = nb_ ^ kb
         if self._msg_word_sls:
-            words = [v[:, sl] for sl in self._msg_word_sls]
-            occ = words[0] != EMPTY
-            nwords = list(words)
-            for fname, kind in self.msg_perm_spec:
-                val = self._unpack_key(nwords, fname)
+            S = self.S
+
+            def remap(val, kind):
                 if kind == "server":
-                    mapped = jnp.take_along_axis(
-                        sigma, jnp.clip(val, 0, S - 1), axis=1
-                    )
-                elif kind == "server_nil":
-                    m2 = (
-                        jnp.take_along_axis(
-                            sigma, jnp.clip(val - 1, 0, S - 1), axis=1
-                        )
-                        + 1
-                    )
-                    mapped = jnp.where(val > 0, m2, 0)
-                elif kind == "server_bitmask":
-                    out = jnp.zeros_like(val)
+                    out = jnp.zeros(dt["perms"].shape[:1] + val.shape, jnp.int32)
+                    for u in range(S):
+                        out = out + jnp.where(
+                            val[None] == u, dt["perms"][:, u, None, None], 0)
+                    return out
+                if kind == "server_nil":
+                    out = jnp.zeros(dt["perms"].shape[:1] + val.shape, jnp.int32)
+                    for u in range(S):
+                        out = out + jnp.where(
+                            val[None] == u + 1,
+                            dt["perms"][:, u, None, None] + 1, 0)
+                    return out
+                if kind == "server_bitmask":
+                    out = jnp.zeros(dt["pow2"].shape[:1] + val.shape, jnp.int32)
                     for j in range(S):
-                        out = out | (((val >> j) & 1) << sigma[:, j : j + 1])
-                    mapped = out
-                else:
-                    raise ValueError(f"unknown msg perm kind {kind}")
-                nwords = self._replace_key(nwords, fname, mapped)
-            nwords = [jnp.where(occ, nw, w) for nw, w in zip(nwords, words)]
-            for sl, arr in zip(self._msg_word_sls, nwords):
-                v = v.at[:, sl].set(arr)
-        return v
+                        out = out + ((val[None] >> j) & 1) * dt["pow2"][:, j, None, None]
+                    return out
+                raise ValueError(f"unknown msg perm kind {kind}")
+
+            ba, bb = self._bag_streams(view, remap)
+            na = na ^ ba
+            nb_ = nb_ ^ bb
+        return combine_pair(na, nb_)
+
+    def _dyn_outpos(self, sigma):
+        """Per-group hash positions under dynamic sigma [..., B, S] (old
+        server i -> new index sigma[..., i]) -> dict of [..., B, Kg]
+        int32. Pure elementwise arithmetic — permutations move whole
+        server blocks, so a lane's destination is affine in sigma."""
+        S = self.S
+        lead = sigma.shape[:-1]  # (..., B)
+        segs: dict[str, list] = {"plain": [], "val": [], "bm": []}
+        for group, skind, nbbase, n in self._dyn_segs:
+            if skind == "rows":
+                rest = n
+                seg = (nbbase
+                       + sigma[..., :, None] * rest
+                       + jnp.arange(rest, dtype=jnp.int32))
+                seg = seg.reshape(lead + (S * rest,))
+            elif skind == "pair":
+                seg = nbbase + sigma[..., :, None] * S + sigma[..., None, :]
+                seg = seg.reshape(lead + (S * S,))
+            else:  # static: scalar lanes keep their position
+                seg = jnp.broadcast_to(
+                    jnp.arange(nbbase, nbbase + n, dtype=jnp.int32),
+                    lead + (n,),
+                )
+            segs[group].append(seg)
+        return {
+            g: (jnp.concatenate(s, axis=-1) if len(s) > 1 else s[0])
+            if s else None
+            for g, s in segs.items()
+        }
+
+    def _hash_dyn(self, view, sigma):
+        """u64 [..., B] hash of ``view`` under dynamic per-state sigma
+        [..., B, S] (leading axes broadcast a permutation batch, e.g.
+        tier 2's composed swaps) — again with no materialized view."""
+        S = self.S
+        outpos = self._dyn_outpos(sigma)
+        sa = sbm = None
+        if self.seed:
+            sa, sbm = seed_salts(self.seed)
+        parts = []
+
+        def stream(vals, op):
+            pa = op.astype(jnp.uint32) * PA
+            pb = op.astype(jnp.uint32) * PB
+            xa_m = mix32(pa + sa) if self.seed else None
+            xb_m = mix32(pb + sbm) if self.seed else None
+            return self._group_stream(vals, pa, pb, xa_m, xb_m)
+
+        if self._ln_plain.size:
+            parts.append(stream(view[:, self._ln_plain], outpos["plain"]))
+        if self._ln_val.size:
+            xv = view[:, self._ln_val]
+            out = jnp.zeros(sigma.shape[:-2] + xv.shape, jnp.int32)
+            for u in range(S):
+                out = out + jnp.where(
+                    xv == u + 1, sigma[..., u][..., None] + 1, 0)
+            parts.append(stream(out, outpos["val"]))
+        if self._ln_bm.size:
+            xb = view[:, self._ln_bm]
+            out = jnp.zeros(sigma.shape[:-2] + xb.shape, jnp.int32)
+            for j in range(S):
+                out = out | ((xb >> j) & 1) << sigma[..., j][..., None]
+            parts.append(stream(out, outpos["bm"]))
+        ka, kb = self._nb_const()
+        na = parts[0][0]
+        nb_ = parts[0][1]
+        for a, b in parts[1:]:
+            na = na ^ a
+            nb_ = nb_ ^ b
+        na = na ^ ka
+        nb_ = nb_ ^ kb
+        if self._msg_word_sls:
+            def remap(val, kind):
+                # sigma [..., B, S]; val [B, M] -> [..., B, M]
+                if kind == "server":
+                    out = jnp.zeros(sigma.shape[:-1] + val.shape[-1:], jnp.int32)
+                    for u in range(S):
+                        out = out + jnp.where(
+                            val == u, sigma[..., u][..., None], 0)
+                    return out
+                if kind == "server_nil":
+                    out = jnp.zeros(sigma.shape[:-1] + val.shape[-1:], jnp.int32)
+                    for u in range(S):
+                        out = out + jnp.where(
+                            val == u + 1, sigma[..., u][..., None] + 1, 0)
+                    return out
+                if kind == "server_bitmask":
+                    out = jnp.zeros(sigma.shape[:-1] + val.shape[-1:], jnp.int32)
+                    for j in range(S):
+                        out = out | ((val >> j) & 1) << sigma[..., j][..., None]
+                    return out
+                raise ValueError(f"unknown msg perm kind {kind}")
+
+            # _bag_streams broadcasts words [1, B, M] against the remap's
+            # leading axes; for dyn the lead is sigma's [..., ] prefix of
+            # [..., B, S] — i.e. [..., B, M] after remap
+            ba, bb = self._bag_streams(view, remap)
+            na = na ^ ba
+            nb_ = nb_ ^ bb
+        return combine_pair(na, nb_)
 
     # ---------------- the static masked-min (tie / full path) ----------------
 
-    def _one_perm(self, view, sig, gi, valmap, pow2, sigma, inv_p):
-        """Apply one STATIC permutation to [B, VL] views; hash; mask to
-        U64_MAX unless the permutation sorts the signature sequence."""
-        S = self.S
-        v = view[:, gi]
-        if self._val_lanes.size:
-            vl = v[:, self._val_lanes]
-            v = v.at[:, self._val_lanes].set(valmap[vl])
-        if self._bm_lanes.size:
-            x = v[:, self._bm_lanes]
-            bits = (x[..., None] >> jnp.arange(S, dtype=jnp.int32)) & 1
-            v = v.at[:, self._bm_lanes].set(
-                jnp.sum(bits * pow2, axis=-1).astype(jnp.int32)
-            )
-        if self._msg_word_sls:
-            words = [v[:, sl] for sl in self._msg_word_sls]
-            occ = words[0] != EMPTY
-            nwords = list(words)
-            for fname, kind in self.msg_perm_spec:
-                val = self._unpack_key(nwords, fname)
-                if kind == "server":
-                    mapped = sigma[jnp.clip(val, 0, S - 1)]
-                elif kind == "server_nil":
-                    mapped = jnp.where(
-                        val > 0, sigma[jnp.clip(val - 1, 0, S - 1)] + 1, 0
-                    )
-                elif kind == "server_bitmask":
-                    bits = (val[..., None] >> jnp.arange(S, dtype=jnp.int32)) & 1
-                    mapped = jnp.sum(bits * pow2, axis=-1).astype(jnp.int32)
-                else:
-                    raise ValueError(f"unknown msg perm kind {kind}")
-                nwords = self._replace_key(nwords, fname, mapped)
-            nwords = [jnp.where(occ, nw, w) for nw, w in zip(nwords, words)]
-            for sl, arr in zip(self._msg_word_sls, nwords):
-                v = v.at[:, sl].set(arr)
-        h = self._perm_hash(v)
-        if sig is None:  # unpruned: every permutation admissible
-            return h
-        ssig = sig[:, inv_p]
-        adm = jnp.all(ge_u64(ssig[:, 1:], ssig[:, :-1]), axis=1)
-        return jnp.where(adm, h, U64_MAX)
-
     def _masked_min(self, view, sig):
         """min over the admissible static permutations (brute force over
-        the S! table; sig=None means no mask — the plain full-S! min).
-
-        The table is processed in scanned blocks with a running min: a
-        flat vmap materializes a [P, B, VL] gather temp, which at P=120
-        and chunk-sized B overflows HBM (observed on the 5-server
-        workload); blocking caps the temp at PBLK*B*VL."""
+        the S! direct tables; sig=None means no mask — the plain full-S!
+        min). Blocked scan with a running min: the [PBLK, B, K] stream
+        temps are bounded to ~512MB per block (P=120 at chunk-sized B
+        would otherwise overflow HBM)."""
         B = view.shape[0]
-        per_perm = max(1, B * self.VL * 4)
-        # 512MB of gather temp per block: small perm sets (S<=4, P<=24)
-        # stay a single flat vmap; P=120 splits into ~10-perm blocks
+        per_perm = max(1, B * max(1, self._K_nb) * 8)
         PBLK = max(1, min(self.P, (512 << 20) // per_perm))
         nblk = (self.P + PBLK - 1) // PBLK
         pad = nblk * PBLK - self.P
@@ -720,24 +913,26 @@ class Canonicalizer:
             # duplicate perm 0: duplicates cannot change a min
             return jnp.concatenate([t, jnp.repeat(t[:1], pad, axis=0)])
 
-        tables = tuple(
-            padt(t).reshape((nblk, PBLK) + t.shape[1:])
-            for t in (self._gidx, self._valmap, self._pow2sig, self._sigma,
-                      self._inv_sigma)
-        )
+        stacked = {
+            k: padt(t).reshape((nblk, PBLK) + t.shape[1:])
+            for k, t in self._dt_full.items()
+        }
 
         def block(best, tb):
-            gi, vm, p2, sg, ip = tb
-            h = jax.vmap(
-                lambda g, v, p, s, i_: self._one_perm(view, sig, g, v, p, s, i_)
-            )(gi, vm, p2, sg, ip)
+            h = self._hash_static(view, tb)  # [PBLK, B]
+            if sig is not None:
+                ssig = jnp.take(sig, tb["inv"], axis=1)  # [B, PBLK, S]
+                adm = jnp.all(
+                    ge_u64(ssig[..., 1:], ssig[..., :-1]), axis=-1
+                ).T  # [PBLK, B]
+                h = jnp.where(adm, h, U64_MAX)
             return jnp.minimum(best, jnp.min(h, axis=0)), None
 
         # derive the init from `view` so it carries the same varying-
         # manual-axes type as the body output under shard_map (a plain
         # jnp.full is unvarying and the scan carry types would mismatch)
         init = (view[:, 0].astype(jnp.uint64) & jnp.uint64(0)) | U64_MAX
-        best, _ = lax.scan(block, init, tables)
+        best, _ = lax.scan(block, init, stacked)
         return best
 
     # ---------------- entry point ----------------
@@ -765,18 +960,23 @@ class Canonicalizer:
         ssig = jnp.take_along_axis(sig, order, axis=1)
         adj_eq = eq_u64(ssig[:, 1:], ssig[:, :-1])  # [B, S-1]
         sigma = jnp.argsort(order, axis=1).astype(jnp.int32)
-        v0 = jnp.take_along_axis(view, self._dyn_gidx(order), axis=1)
-        v0 = self._apply_sigma_values(v0, sigma)
-        fp = self._perm_hash(v0)
+        fp = self._hash_dyn(view, sigma)
 
         # ---- tier 2: disjoint adjacent-swap products on the SORTED view.
         # t composed with the argsort is admissible iff every swapped pair
         # is signature-tied; for states whose tie groups are all <= 2
         # these are ALL the admissible permutations, so min(tier1, tier2)
-        # is exactly the masked full-S! min for them.
-        t_fps = jax.vmap(
-            lambda gi, vm, p2, sg: self._one_perm(v0, None, gi, vm, p2, sg, None)
-        )(self._t_gidx, self._t_valmap, self._t_pow2, self._t_sigma)  # [T, B]
+        # is exactly the masked full-S! min for them. The composed
+        # permutation sigma_c[i] = t_sigma[sigma[i]] feeds the same
+        # direct dynamic hash — no sorted view is ever materialized.
+        comp = jnp.zeros(
+            (self._t_sigma.shape[0],) + sigma.shape, jnp.int32
+        )  # [T, B, S]
+        for u in range(self.S):
+            comp = comp + jnp.where(
+                sigma[None] == u, self._t_sigma[:, u, None, None], 0
+            )
+        t_fps = self._hash_dyn(view, comp)  # [T, B]
         t_valid = jnp.all(
             adj_eq[None, :, :] | ~self._t_edge_mask[:, None, :], axis=2
         )  # [T, B]

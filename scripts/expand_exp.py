@@ -1,0 +1,71 @@
+"""Expand-stage structural experiments (round 5).
+
+Times handle_message (the 93 GB/chunk cost-analysis monster) and the
+full expand under structural variants:
+  - state-outer vmap (production) vs instance-outer vmap
+  - msg_slots 32 (bench default) vs 16
+
+Usage: python scripts/expand_exp.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel"):
+            np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
+
+
+def timeit(name, fn, *args):
+    _sync(fn(*args))
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(4):
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / 4)
+    med = sorted(ts)[len(ts) // 2]
+    print(f"{name:44s} {med*1e3:9.1f} ms")
+
+
+def main():
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+    C = 4096
+
+    for slots in (32, 16):
+        setup = build_from_cfg(cfg, msg_slots=slots)
+        model = setup.model
+        M, W = model.p.msg_slots, model.layout.W
+        batch = jnp.zeros((C, W), jnp.int32)
+        marange = jnp.arange(M, dtype=jnp.int32)
+
+        hm_so = jax.jit(lambda b: jax.vmap(
+            lambda s: jax.vmap(lambda m: model._handle_message(s, m))(marange)
+        )(b))
+        timeit(f"M={slots} handle_message state-outer", hm_so, batch)
+
+        hm_io = jax.jit(lambda b: jax.vmap(
+            lambda m: jax.vmap(lambda s: model._handle_message(s, m))(b)
+        )(marange))
+        timeit(f"M={slots} handle_message instance-outer", hm_io, batch)
+
+        full = jax.jit(lambda b: jax.vmap(model._expand1)(b))
+        timeit(f"M={slots} full expand state-outer", full, batch)
+
+
+if __name__ == "__main__":
+    main()

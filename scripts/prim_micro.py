@@ -1,0 +1,236 @@
+"""Primitive-cost microbenchmark on the live backend (round 5).
+
+Times the building blocks the chunk program and canonicalizer are made
+of, so rewrites target the ops that actually serialize on this TPU:
+  - elementwise mix throughput (the VPU roofline reference)
+  - per-element dynamic gather (take_along_axis with [B, K] indices)
+  - one-hot select-sum equivalent of the same gather (the candidate fix)
+  - row gather (one index per row)
+  - scatter (row + element)
+  - 2-key u32 sort at chunk and frontier sizes
+  - dynamic_update_slice (the candidate scatter replacement)
+  - searchsorted probe
+  - while_loop per-iteration overhead (the wave-fusion floor)
+  - null dispatch (the tunnel floor)
+
+Usage: python scripts/prim_micro.py [reps]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+
+
+def _sync(out):
+    """block_until_ready does not actually wait on the axon tunnel
+    backend (measured 0.03 ms for programs that cost >100 ms through
+    profile.py's device_get path) — force a real sync by fetching one
+    element of every output leaf."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
+
+
+def timeit(name, fn, *args):
+    _sync(fn(*args))  # compile
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    med = sorted(ts)[len(ts) // 2]
+    print(f"{name:48s} {med*1e3:10.2f} ms  (min {min(ts)*1e3:.2f})")
+    return med
+
+
+def main():
+    print("devices:", jax.devices())
+    key = jax.random.PRNGKey(0)
+
+    # --- null dispatch (tunnel floor) ---
+    one = jnp.zeros((8,), jnp.int32)
+    timeit("null dispatch", jax.jit(lambda x: x + 1), one)
+
+    # --- calibration: 64 chained 4096^3 bf16 matmuls (~8.8 TFLOP) ---
+    a = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm64(m):
+        def body(i, x):
+            return x @ a
+        return lax.fori_loop(0, 64, body, m)
+
+    timeit("64 x 4096^3 bf16 matmul (8.8 TFLOP)", mm64, a)
+
+    # --- elementwise throughput: 10M u32 lanes x 12 mix ops ---
+    x32 = jax.random.randint(key, (10_000_000,), 0, 1 << 30, jnp.int32).astype(jnp.uint32)
+
+    @jax.jit
+    def mixchain(x):
+        for _ in range(4):
+            x = (x ^ (x >> np.uint32(16))) * np.uint32(0x85EBCA6B)
+            x = (x ^ (x >> np.uint32(13))) * np.uint32(0xC2B2AE35)
+            x = x ^ (x >> np.uint32(16))
+        return x.sum()
+
+    timeit("elementwise 10M lanes x 12 mix ops", mixchain, x32)
+
+    # --- per-element dynamic gather: [B, VL] indices into [B, VL] ---
+    B, VL = 32768, 334
+    view = jax.random.randint(key, (B, VL), 0, 100, jnp.int32)
+    idx = jax.random.randint(key, (B, VL), 0, VL, jnp.int32)
+    timeit(
+        f"take_along_axis [B={B}, VL={VL}] per-elem idx",
+        jax.jit(lambda v, i: jnp.take_along_axis(v, i, axis=1).sum()),
+        view, idx,
+    )
+
+    # --- same but small-idx one-hot select over S=5 server blocks ---
+    S = 5
+    inv = jax.random.randint(key, (B, S), 0, S, jnp.int32)
+    blk = view[:, : S * 66].reshape(B, S, 66)
+
+    @jax.jit
+    def onehot_perm(b, i):
+        out = jnp.zeros_like(b)
+        for s in range(S):
+            out = out + jnp.where((i[:, :, None] == s), b[:, s : s + 1, :], 0)
+        return out.sum()
+
+    timeit(f"one-hot block perm [B={B}, S=5, rest=66]", onehot_perm, blk, inv)
+
+    # --- take_along_axis with [B, S] idx (tiny K) ---
+    timeit(
+        f"take_along_axis [B={B}, S=5] idx",
+        jax.jit(lambda v, i: jnp.take_along_axis(v[:, :S], i, axis=1).sum()),
+        view, inv,
+    )
+
+    # --- row gather: VC rows of W words by one index per row ---
+    VC, W, CA = 65536, 144, 217088
+    flat = jax.random.randint(key, (CA + 1, W), 0, 100, jnp.int32)
+    sel = jax.random.randint(key, (VC,), 0, CA, jnp.int32)
+    timeit(f"row gather [{VC} rows x {W}w from {CA}]",
+           jax.jit(lambda f, s: f[s].sum()), flat, sel)
+
+    # --- row scatter: VC rows into FCAP+1 buffer ---
+    FCAP = 1 << 20
+    buf = jnp.zeros((FCAP + 1, W), jnp.int32)
+    rows = jax.random.randint(key, (VC, W), 0, 100, jnp.int32)
+    dst = jax.random.randint(key, (VC,), 0, FCAP, jnp.int32)
+    timeit(f"row scatter [{VC} rows x {W}w into {FCAP}]",
+           jax.jit(lambda b, r, d: b.at[d].set(r)), buf, rows, dst)
+
+    # --- element scatter: CA element writes (the sel construction) ---
+    vals = jnp.arange(CA, dtype=jnp.int32)
+    edst = jax.random.randint(key, (CA,), 0, VC, jnp.int32)
+    ebuf = jnp.zeros((VC + 1,), jnp.int32)
+    timeit(f"elem scatter [{CA} writes into {VC}]",
+           jax.jit(lambda b, d, v: b.at[d].set(v)), ebuf, edst, vals)
+
+    # --- contiguous write: dynamic_update_slice VC rows into FCAP ---
+    timeit(
+        f"dynamic_update_slice [{VC} rows x {W}w]",
+        jax.jit(lambda b, r, c: lax.dynamic_update_slice(b, r, (c, 0))),
+        buf, rows, jnp.int32(1000),
+    )
+
+    # --- 2-key u32 sorts ---
+    from raft_tpu.ops.hashing import sort_u64, sort_u64_with_idx
+
+    fps_vc = jax.random.randint(key, (VC,), 0, 1 << 30, jnp.int32).astype(jnp.uint64)
+    fps_1m = jax.random.randint(key, (FCAP + VC,), 0, 1 << 30, jnp.int32).astype(jnp.uint64)
+    timeit(f"sort_u64 [{VC}]", jax.jit(sort_u64), fps_vc)
+    timeit(f"sort_u64_with_idx [{VC}]",
+           jax.jit(lambda x: sort_u64_with_idx(x)[0]), fps_vc)
+    timeit(f"sort_u64 [{FCAP + VC}] (wave merge)", jax.jit(sort_u64), fps_1m)
+
+    # --- searchsorted probe: VC vals into 8M run ---
+    run = jnp.sort(jax.random.randint(key, (1 << 23,), 0, 1 << 62, jnp.int64).astype(jnp.uint64))
+    timeit(
+        f"searchsorted probe [{VC} into 8M]",
+        jax.jit(lambda r, v: jnp.searchsorted(r, v).sum()), run, fps_vc,
+    )
+
+    # --- while_loop per-iteration overhead: 256 trivial iterations ---
+    @jax.jit
+    def wloop(x):
+        def body(c):
+            i, a = c
+            return i + 1, a + i
+        _, a = lax.while_loop(lambda c: c[0] < 256, body, (jnp.int32(0), x))
+        return a
+
+    timeit("while_loop 256 trivial iters", wloop, jnp.int32(0))
+
+    # --- while_loop with a real body: 16 iters of sort VC ---
+    @jax.jit
+    def wloop_sort(fps):
+        def body(c):
+            i, a = c
+            return i + 1, sort_u64(a ^ jnp.uint64(1))
+        _, a = lax.while_loop(lambda c: c[0] < 16, body, (jnp.int32(0), fps))
+        return a
+
+    timeit("while_loop 16 x sort_u64[VC] iters", wloop_sort, fps_vc)
+
+    # --- DISPATCH PIPELINING: 16 chained separate jit calls, one sync ---
+    step = jax.jit(lambda x: sort_u64(x ^ jnp.uint64(1)))
+
+    def chained16(fps):
+        for _ in range(16):
+            fps = step(fps)
+        return fps
+
+    timeit("16 chained DISPATCHES of sort_u64[VC]", chained16, fps_vc)
+
+    # --- static-table permutation gather under vmap (masked_min path) ---
+    VL5 = 330
+    view5 = jax.random.randint(key, (32768, VL5), 0, 100, jnp.int32)
+    gidx120 = jnp.asarray(
+        np.stack([np.random.permutation(VL5) for _ in range(120)]).astype(np.int32)
+    )
+
+    @jax.jit
+    def vmap_perm_gather(v, g):
+        h = jax.vmap(lambda gi: v[:, gi].sum(dtype=jnp.int32))(g)
+        return h
+
+    timeit("vmap 120-perm gather [32768 x 330]", vmap_perm_gather, view5, gidx120)
+    timeit("vmap 12-perm gather [32768 x 330]",
+           vmap_perm_gather, view5, gidx120[:12])
+
+    # --- same via UNROLLED static numpy indexing (12 perms) ---
+    gidx_np = np.asarray(gidx120)
+
+    @jax.jit
+    def unrolled_perm(v):
+        h = jnp.int32(0)
+        for t in range(12):
+            h = h + v[:, gidx_np[t]].sum(dtype=jnp.int32)
+        return h
+
+    timeit("unrolled 12 static-perm gathers [32768 x 330]", unrolled_perm, view5)
+
+    # --- one-hot matmul permutation of per-server blocks ---
+    S5 = 5
+    blk5 = view5[:, : S5 * 66].reshape(32768, S5, 66)
+    oh = jax.nn.one_hot(inv, S5, dtype=jnp.int32)  # wrong inv shape ok for timing
+
+    @jax.jit
+    def mm_perm(b, o):
+        return jnp.einsum("bts,bsk->btk", o, b).sum(dtype=jnp.int32)
+
+    timeit("one-hot matmul block perm [32768, 5, 66]", mm_perm, blk5, oh)
+
+
+if __name__ == "__main__":
+    main()

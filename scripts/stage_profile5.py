@@ -1,0 +1,79 @@
+"""Round-5 stage re-profile with a trustworthy timer.
+
+block_until_ready does not reliably wait on the axon tunnel backend
+(scripts/prim_micro.py: a null dispatch reads 0.03 ms via
+block_until_ready but 117 ms via device_get), so this harness re-times
+the profile.py stages with a device_get sync AND an inner-pipelined
+variant (N back-to-back dispatches, one sync, divide by N) that cancels
+the tunnel floor — the number that matches production wave walls, where
+dispatches pipeline.
+
+Usage: python scripts/stage_profile5.py [raft3|raft5|fsync]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import raft_tpu.checker.profile as prof_mod
+
+
+def _sync(out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel"):
+            np.asarray(jax.device_get(leaf.ravel()[:1] if leaf.ndim else leaf))
+
+
+def _time(fn, *args, reps: int = 5, inner: int = 1) -> float:
+    _sync(fn(*args))  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(4):  # pipeline 4 dispatches, one sync
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / 4)
+    return float(np.median(ts))
+
+
+prof_mod._time = _time
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "raft3"
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+
+    base = "/root/reference/specifications"
+    if which == "raft3":
+        cfg = parse_cfg(f"{base}/standard-raft/Raft.cfg")
+        setup = build_from_cfg(cfg, msg_slots=32)
+        kw = dict(chunk=4096, frontier_cap=1 << 18, seen_cap=1 << 21,
+                  warm_depth=14)
+    elif which == "raft5":
+        cfg = parse_cfg(f"{base}/standard-raft/Raft.cfg")
+        cfg.constants["InitServerCount"] = 5
+        cfg.constants["Server"] = ["s1", "s2", "s3", "s4", "s5"]
+        setup = build_from_cfg(cfg, msg_slots=64)
+        kw = dict(chunk=2048, frontier_cap=1 << 21, seen_cap=1 << 22,
+                  warm_depth=10, max_frontier_cap=1 << 22)
+    else:
+        cfg = parse_cfg(f"{base}/standard-raft-fsync/RaftFsync.cfg")
+        setup = build_from_cfg(cfg, msg_slots=32)
+        kw = dict(chunk=2048, frontier_cap=1 << 18, seen_cap=1 << 21,
+                  warm_depth=11)
+
+    out = prof_mod.profile_stages(
+        setup.model, invariants=setup.invariants, symmetry=True, **kw
+    )
+    print(prof_mod.render(out))
+
+
+if __name__ == "__main__":
+    main()
