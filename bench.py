@@ -4,20 +4,23 @@ Workload: BASELINE.md row 1 — the reference `standard-raft/Raft.cfg` state
 space on the device-resident checker (DeviceBFS), reported as sustained
 distinct-states/sec over a time-budgeted deep run.
 
-Protocol (round-2 verdict items 1 and Weak #6; cmp ordered before the
-gate in round 4 — see the in-code note on tunnel dispatch-floor drift):
-  1. vs_baseline is measured on the SAME workload both sides: wall-clock
-     to the same depth cap (BENCH_CMP_DEPTH, default 16) for the Python
-     oracle (the TLC stand-in; reference publishes no numbers and TLC is
-     not in this image) and for DeviceBFS. vs_baseline = t_oracle / t_tpu;
-     vs_strong_baseline divides by the SAME engine on the XLA CPU backend.
+Round-5 protocol (verdict Next #5 — reproducibility under tunnel
+dispatch-floor drift and remote-compile stalls):
+  0. PRECOMPILE phase, untimed: the engine is built at its FINAL
+     capacities (no growth retraces) and DeviceBFS.precompile() compiles
+     the chunk program + the full LSM merge ladder. With the persistent
+     compile cache (.jax_cache, committed) this is a disk reload; cold
+     it is the one-time compile cost, and either way the TIMED region
+     never compiles. LSM consolidation is host-side since round 5, so
+     no program signature can appear mid-run.
+  1. The deep run comes FIRST (it is the headline number) with per-wave
+     metrics; the measured null-dispatch floor is reported alongside.
   2. Parity gate before any number is emitted: depths 1..GATE_DEPTH at
-     two chunk geometries must produce bit-identical per-depth counts
-     (defense against the axon batch-geometry miscompile class fixed in
-     ops/bag.py). A gate failure prints value 0 and exits nonzero.
-  3. value is the deep-run sustained rate (time budget
-     BENCH_TIME_BUDGET_S, default 300 s), reported with depth/distinct
-     detail so depth-dependent rate growth is visible rather than hidden.
+     two chunk geometries must produce bit-identical per-depth counts.
+     A gate failure prints value 0 and exits nonzero.
+  3. Same-depth comparison for vs_baseline (python oracle = TLC stand-in;
+     the reference publishes no numbers and TLC is not in this image) and
+     vs_strong_baseline (the SAME engine on the XLA CPU backend).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -31,19 +34,30 @@ import time
 CFG = "/root/reference/specifications/standard-raft/Raft.cfg"
 
 
+def measure_floor(reps: int = 5) -> float:
+    """Median wall seconds of a null dispatch + device_get sync — the
+    tunnel floor every wave pays once. block_until_ready does not
+    actually wait on this backend; device_get does."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    np.asarray(jax.device_get(f(x)))  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(f(x)))
+        ts.append(time.perf_counter() - t0)
+    return float(sorted(ts)[len(ts) // 2])
+
+
 def main():
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "300"))
     cmp_depth = int(os.environ.get("BENCH_CMP_DEPTH", "16"))
     gate_depth = int(os.environ.get("BENCH_GATE_DEPTH", "12"))
     chunk = int(os.environ.get("BENCH_CHUNK", "4096"))
-    deep_caps = dict(
-        frontier_cap=1 << 20,
-        seen_cap=1 << 23,
-        journal_cap=1 << 23,
-        max_frontier_cap=1 << 22,
-        max_seen_cap=1 << 25,
-        max_journal_cap=1 << 25,
-    )
 
     from raft_tpu.utils.cfg import parse_cfg
     from raft_tpu.models.registry import build_from_cfg
@@ -54,28 +68,37 @@ def main():
     setup = build_from_cfg(cfg, msg_slots=32)
     model, invs = setup.model, setup.invariants
 
-    def device(ch, **caps):
-        return DeviceBFS(model, invariants=invs, symmetry=True, chunk=ch, **caps)
-
-    # 1. same-depth comparison FIRST (workload identical both sides).
-    # Ordering note: long tunnel-connected processes develop a ~100 ms
-    # per-dispatch floor after heavy compile activity, and the shallow
-    # cmp run is dispatch-latency-bound (small waves) — measured 16 s in
-    # a young process vs 30-50 s after the gate's compiles. The gate
-    # still validates below BEFORE any number is emitted.
-    big = device(chunk, **deep_caps)
-    big.run(max_depth=1)  # compile outside the timed window
+    # 0. build at FINAL capacities (growth would retrace the chunk
+    # program mid-run: ~100 s each through the remote-compile service)
+    # and warm every program signature before anything is timed.
     t0 = time.perf_counter()
-    tpu_cmp = big.run(max_depth=cmp_depth)
-    t_tpu = time.perf_counter() - t0
+    big = DeviceBFS(
+        model, invariants=invs, symmetry=True, chunk=chunk,
+        frontier_cap=1 << 22, seen_cap=1 << 25, journal_cap=1 << 25,
+        max_frontier_cap=1 << 22, max_seen_cap=1 << 25,
+        max_journal_cap=1 << 25,
+    )
+    big.precompile()
+    precompile_s = time.perf_counter() - t0
+    floor_s = measure_floor()
 
-    # 2. parity gate: a small-geometry arm at a DIFFERENT chunk size,
-    # plus an arm at the exact deep-run geometry (the big instance is
-    # reused for the deep run below)
+    # 1. deep run: sustained rate under the time budget (the headline),
+    # timed in a process region that compiles nothing
+    deep = big.run(time_budget_s=budget, collect_metrics=True)
+    waves = deep.metrics or []
+    trajectory = [
+        {k: m[k] for k in ("depth", "new", "wave_s", "distinct_per_s")}
+        for m in waves[-10:]
+    ]
+
+    # 2. parity gate at a second chunk geometry (defense against the
+    # batch-geometry miscompile class, ops/bag.py)
     small_chunk = chunk // 2 if chunk // 2 >= 128 else chunk * 2
     small_fcap = ((1 << 17) + small_chunk - 1) // small_chunk * small_chunk
-    small = device(small_chunk, frontier_cap=small_fcap,
-                   seen_cap=1 << 21, journal_cap=1 << 21)
+    small = DeviceBFS(
+        model, invariants=invs, symmetry=True, chunk=small_chunk,
+        frontier_cap=small_fcap, seen_cap=1 << 21, journal_cap=1 << 21,
+    )
     gate = parity_gate(depth=gate_depth, checkers=(small, big))
     if not gate.ok:
         print(json.dumps({
@@ -89,6 +112,12 @@ def main():
         }))
         return 1
 
+    # 3. same-depth comparison (workload identical on every side).
+    # The engine is warm — this times execution, not compilation.
+    t0 = time.perf_counter()
+    tpu_cmp = big.run(max_depth=cmp_depth)
+    t_tpu = time.perf_counter() - t0
+
     from raft_tpu.models.registry import oracle_for_setup
 
     oracle = oracle_for_setup(setup)
@@ -100,8 +129,6 @@ def main():
         ores["distinct"] == tpu_cmp.distinct
         and ores["depth_counts"] == tpu_cmp.depth_counts
     )
-    # a null vs_baseline must say WHY (round-3 verdict Weak #6: a slow-day
-    # oracle timeout silently reads as "not measured")
     cmp_note = None
     if not same_workload:
         cmp_note = (
@@ -110,11 +137,9 @@ def main():
             else "oracle counts diverge from device counts"
         )
 
-    # 2b. strong CPU baseline (round-4 verdict Next #5): the SAME engine
-    # on the XLA CPU backend (vectorized single-core on this host), same
-    # depth-capped workload, compile excluded — a far stronger denominator
-    # than the interpreted python oracle. Subprocess because the JAX
-    # platform is process-global.
+    # 3b. strong CPU baseline: the SAME engine on the XLA CPU backend,
+    # same depth-capped workload (subprocess: JAX platform is
+    # process-global)
     import subprocess
 
     strong = None
@@ -134,20 +159,13 @@ def main():
         and list(strong.get("depth_counts", [])) == list(tpu_cmp.depth_counts)
     )
 
-    # 3. deep run: sustained rate under the time budget
-    deep = big.run(time_budget_s=budget)
-
     out = {
         "metric": "distinct_states_per_sec_raft3_cfg",
         "value": round(deep.states_per_sec, 1),
         "unit": "distinct states/s",
-        # the ratio is only meaningful on the identical workload: null it
-        # out if the oracle diverged or was cut short by its own budget
         "vs_baseline": (
             round(t_oracle / t_tpu, 2) if t_tpu > 0 and same_workload else None
         ),
-        # same-engine-on-CPU wall-clock ratio, identical workload: the
-        # honest "optimized CPU checker" yardstick (BASELINE.md §strong)
         "vs_strong_baseline": (
             round(strong["seconds"] / t_tpu, 2)
             if t_tpu > 0 and strong_match else None
@@ -160,6 +178,9 @@ def main():
                 "seconds": round(deep.seconds, 2),
                 "violation": deep.violation.invariant if deep.violation else None,
             },
+            "dispatch_floor_ms": round(floor_s * 1e3, 1),
+            "precompile_s": round(precompile_s, 1),
+            "wave_trajectory": trajectory,
             "same_depth_cmp": {
                 "depth": cmp_depth,
                 "distinct": tpu_cmp.distinct,

@@ -163,32 +163,39 @@ class RunLSM:
         sentinel padding (bounds probe count and lane waste). `bound`
         must be an upper bound on the real fingerprints held per row; the
         truncation is then safe (the engine's capacity guard keeps it
-        sound at TOPSZ)."""
-        occ_runs = [self.runs[i] for i in range(len(self.runs)) if self.occ[i]]
-        if len(occ_runs) <= 1:
+        sound at TOPSZ).
+
+        HOST-side (round 5): the round-4 device repack compiled one
+        program per (occupied-shapes, target) signature — ~20-40 s each
+        on the tunnel's remote-compile service, observed as 30-100 s
+        mid-run stalls (a depth-19 wave measured 97 s against a 1.4 s
+        neighbor). A numpy sort of a few tens of MB plus one H2D upload
+        costs ~0.2 s and compiles NOTHING; seeding pads on the host so
+        no pad program is needed either."""
+        if sum(self.occ) <= 1:
             return
-        target = min(max(self.R0, pow2_at_least(bound)), self.TOPSZ)
-        key = ("consol", tuple(r.shape[-1] for r in occ_runs), target)
-
-        def build():
-            return lambda *rs: sort_u64(
-                jnp.concatenate(rs, axis=-1), axis=-1)[..., :target]
-
-        merged = self._jit(key, build)(*occ_runs)
-        lv = 0
-        while self.lv_size(lv) < target:
-            lv += 1
-        while lv >= len(self.runs):
-            self.add_level()
-        for i in range(len(self.runs)):
-            self.occ[i] = False
-            self.runs[i] = self._empty_of(self.lv_size(i))
-        self.runs[lv] = merged
-        self.occ[lv] = True
+        rows = self.export_real()
+        if self._lead:
+            n = max((len(r) for r in rows), default=0)
+            target = min(max(self.R0, pow2_at_least(max(1, n))), self.TOPSZ)
+            host = np.full(self._lead + (target,), np.uint64(U64_MAX))
+            for d, r in enumerate(rows):
+                host[d, : len(r)] = r[:target]
+        else:
+            target = min(
+                max(self.R0, pow2_at_least(max(1, len(rows)))), self.TOPSZ
+            )
+            host = np.full((target,), np.uint64(U64_MAX))
+            host[: min(len(rows), target)] = rows[:target]
+        self.seed(host)
 
     def seed(self, host_rows: np.ndarray) -> None:
         """Start from a host array [*lead, n] of per-row sorted real
-        fingerprints padded with U64_MAX (Init seeding / resume)."""
+        fingerprints padded with U64_MAX (Init seeding / resume).
+
+        Padding to the level size happens on the HOST: a device pad
+        program costs a ~20 s remote compile per (n, size) signature on
+        the tunnel backend, a numpy concatenate costs nothing."""
         n = host_rows.shape[-1]
         if n > self.TOPSZ:
             raise OverflowError(
@@ -199,11 +206,30 @@ class RunLSM:
         lv = 0
         while self.lv_size(lv) < n:
             lv += 1
+        size = self.lv_size(lv)
+        host_rows = np.asarray(host_rows, dtype=np.uint64)
+        if n < size:
+            pad = np.full(
+                host_rows.shape[:-1] + (size - n,), np.uint64(U64_MAX)
+            )
+            host_rows = np.concatenate([host_rows, pad], axis=-1)
         self.reset(max(self._init_levels, lv + 1))
-        self.runs[lv] = self._pad_run(
-            self._put(host_rows.astype(np.uint64)), self.lv_size(lv)
-        )
+        self.runs[lv] = self._put(host_rows)
         self.occ[lv] = True
+
+    def warmup(self) -> None:
+        """Execute one sentinel merge per ladder level so every merge
+        signature a run can need is compiled (and lands in the
+        persistent compile cache) BEFORE the timed region. The cascade
+        only ever merges equal-size runs (carries double exactly), so
+        this is the complete signature set."""
+        for i in range(len(self.runs)):
+            size = self.lv_size(i)
+            e = self._empty_of(size)
+            if size >= self.TOPSZ:
+                self._merge(e, e, out=size)
+                break
+            self._merge(e, e)
 
     def export_host(self) -> list[np.ndarray]:
         """Occupied runs fetched to host (raw, sentinel-padded)."""

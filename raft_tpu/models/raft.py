@@ -563,20 +563,17 @@ class RaftModel:
         st_dst = d["state"][dst]
         recv = occupied & (kcnt > 0)  # ReceivableMessage (Raft.tla:181-187)
 
-        def reply(resp_hi, resp_lo):
-            """Reply(response, request) — Raft.tla:170-176."""
-            c2 = bag.bag_discard_at(cnt, m)
-            return bag.bag_put(hi, lo, c2, resp_hi, resp_lo)  # (+existed,+ovf)
+        # Reply(response, request) — Raft.tla:170-176. The six handler
+        # branches are pairwise DISJOINT (mtype/term/LogOk guards), so the
+        # incoming Discard and the response Send collapse into ONE
+        # bag_discard + ONE bag_put on the branch-selected response at the
+        # end (bag_put embeds an M-lane slot sort; the round-4 kernel paid
+        # it three times per slot instance).
+        c2 = bag.bag_discard_at(cnt, m)
 
         # --- UpdateTerm (Raft.tla:348-355): any DOMAIN record (count may be
         # 0!) with mterm > currentTerm[mdest]; message untouched.
         b_upd = occupied & (mterm > ct_dst)
-        s_upd = self._asm(
-            d,
-            currentTerm=d["currentTerm"].at[dst].set(mterm),
-            state=d["state"].at[dst].set(FOLLOWER),
-            votedFor=d["votedFor"].at[dst].set(NIL),
-        )
 
         # --- HandleRequestVoteRequest (Raft.tla:360-381)
         last_t = self._last_term(d, dst)
@@ -597,18 +594,6 @@ class RaftModel:
             msource=dst,
             mdest=src,
         )
-        hi1, lo1, cnt1, ex1, ovf1 = reply(rhi, rlo)
-        if p.strict_send_once:
-            # FlexibleRaft Reply (FlexibleRaft.tla:148-151): disabled when
-            # the response already exists.
-            b_rvreq &= ~ex1
-        s_rvreq = self._asm(
-            d,
-            votedFor=jnp.where(grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]),
-            msg_hi=hi1,
-            msg_lo=lo1,
-            msg_cnt=cnt1,
-        )
 
         # --- HandleRequestVoteResponse (Raft.tla:386-401)
         b_rvresp = recv & (mtype == RVRESP) & (mterm == ct_dst)
@@ -617,7 +602,6 @@ class RaftModel:
             d["votesGranted"].at[dst].set(d["votesGranted"][dst] | (jnp.int32(1) << src)),
             d["votesGranted"],
         )
-        s_rvresp = self._asm(d, votesGranted=vg, msg_cnt=bag.bag_discard_at(cnt, m))
 
         # --- AppendEntries request handling: LogOk (Raft.tla:406-410)
         prev_idx = u("mprevLogIndex")
@@ -644,10 +628,6 @@ class RaftModel:
         rjhi, rjlo = self._pack(
             mtype=AERESP, mterm=ct_dst, msuccess=0, mmatchIndex=0, msource=dst, mdest=src
         )
-        hi2, lo2, cnt2, ex2, ovf2 = reply(rjhi, rjlo)
-        if p.strict_send_once:
-            b_reject &= ~ex2
-        s_reject = self._asm(d, msg_hi=hi2, msg_lo=lo2, msg_cnt=cnt2)
 
         # --- AcceptAppendEntriesRequest (Raft.tla:454-485)
         b_accept = (
@@ -694,24 +674,6 @@ class RaftModel:
             msource=dst,
             mdest=src,
         )
-        hi3, lo3, cnt3, ex3, ovf3 = reply(achi, aclo)
-        if p.strict_send_once:
-            b_accept &= ~ex3
-        upd_accept = dict(
-            state=d["state"].at[dst].set(FOLLOWER),
-            commitIndex=d["commitIndex"].at[dst].set(u("mcommitIndex")),
-            log_term=d["log_term"].at[dst].set(nlt),
-            log_value=d["log_value"].at[dst].set(nlv),
-            log_len=d["log_len"].at[dst].set(new_ll),
-            msg_hi=hi3,
-            msg_lo=lo3,
-            msg_cnt=cnt3,
-        )
-        if p.has_fsync and p.fsync_follower_reply:
-            # FollowerFsyncBeforeReply: fsyncIndex := Len(new_log)
-            # (RaftFsync.tla:468-470), even when the log didn't change.
-            upd_accept["fsyncIndex"] = d["fsyncIndex"].at[dst].set(new_ll)
-        s_accept = self._asm(d, **upd_accept)
 
         # --- HandleAppendEntriesResponse (Raft.tla:490-505)
         b_aeresp = recv & (mtype == AERESP) & (mterm == ct_dst)
@@ -725,32 +687,72 @@ class RaftModel:
             ),
         )
         mi2 = jnp.where(succm, d["matchIndex"].at[dst, src].set(mmatch), d["matchIndex"])
-        upd_aeresp = dict(
-            nextIndex=ni2,
-            matchIndex=mi2,
-            msg_cnt=bag.bag_discard_at(cnt, m),
+
+        # --- shared Reply: put the branch-selected response once ---
+        resp_hi = jnp.where(b_rvreq, rhi, jnp.where(b_reject, rjhi, achi))
+        resp_lo = jnp.where(b_rvreq, rlo, jnp.where(b_reject, rjlo, aclo))
+        phi, plo, pcnt, ex, povf = bag.bag_put(hi, lo, c2, resp_hi, resp_lo)
+        if p.strict_send_once:
+            # FlexibleRaft Reply (FlexibleRaft.tla:148-151): disabled when
+            # the response already exists (ex is the selected response's).
+            b_rvreq &= ~ex
+            b_reject &= ~ex
+            b_accept &= ~ex
+        putb = b_rvreq | b_reject | b_accept
+        dropb = b_rvresp | b_aeresp  # Discard only, no response
+
+        # --- per-field combination (disjoint branches => order-free) ---
+        upd = dict(
+            currentTerm=jnp.where(
+                b_upd, d["currentTerm"].at[dst].set(mterm), d["currentTerm"]),
+            state=jnp.where(
+                b_upd | b_accept, d["state"].at[dst].set(FOLLOWER), d["state"]),
+            votedFor=jnp.where(
+                b_upd, d["votedFor"].at[dst].set(NIL),
+                jnp.where(b_rvreq & grant,
+                          d["votedFor"].at[dst].set(src + 1), d["votedFor"])),
+            votesGranted=jnp.where(b_rvresp, vg, d["votesGranted"]),
+            commitIndex=jnp.where(
+                b_accept, d["commitIndex"].at[dst].set(u("mcommitIndex")),
+                d["commitIndex"]),
+            log_term=jnp.where(
+                b_accept, d["log_term"].at[dst].set(nlt), d["log_term"]),
+            log_value=jnp.where(
+                b_accept, d["log_value"].at[dst].set(nlv), d["log_value"]),
+            log_len=jnp.where(
+                b_accept, d["log_len"].at[dst].set(new_ll), d["log_len"]),
+            nextIndex=jnp.where(b_aeresp, ni2, d["nextIndex"]),
+            matchIndex=jnp.where(b_aeresp, mi2, d["matchIndex"]),
+            msg_hi=jnp.where(putb, phi, hi),
+            msg_lo=jnp.where(putb, plo, lo),
+            msg_cnt=jnp.where(putb, pcnt, jnp.where(dropb, c2, cnt)),
         )
+        if p.has_fsync and p.fsync_follower_reply:
+            # FollowerFsyncBeforeReply: fsyncIndex := Len(new_log)
+            # (RaftFsync.tla:468-470), even when the log didn't change.
+            upd["fsyncIndex"] = jnp.where(
+                b_accept, d["fsyncIndex"].at[dst].set(new_ll), d["fsyncIndex"])
         if p.has_pending_response:
-            upd_aeresp["pendingResponse"] = d["pendingResponse"].at[dst].set(
-                d["pendingResponse"][dst] & ~(jnp.int32(1) << src)
-            )
-        s_aeresp = self._asm(d, **upd_aeresp)
+            upd["pendingResponse"] = jnp.where(
+                b_aeresp,
+                d["pendingResponse"].at[dst].set(
+                    d["pendingResponse"][dst] & ~(jnp.int32(1) << src)),
+                d["pendingResponse"])
+        succ = self._asm(d, **upd)
 
         branches = [
-            (b_upd, s_upd, R_UPDATETERM, jnp.asarray(False)),
-            (b_rvreq, s_rvreq, R_HANDLE_RVREQ, ovf1),
-            (b_rvresp, s_rvresp, R_HANDLE_RVRESP, jnp.asarray(False)),
-            (b_reject, s_reject, R_REJECT_AE, ovf2),
-            (b_accept, s_accept, R_ACCEPT_AE, ovf3 | ac_ovf),
-            (b_aeresp, s_aeresp, R_HANDLE_AERESP, jnp.asarray(False)),
+            (b_upd, R_UPDATETERM, jnp.asarray(False)),
+            (b_rvreq, R_HANDLE_RVREQ, povf),
+            (b_rvresp, R_HANDLE_RVRESP, jnp.asarray(False)),
+            (b_reject, R_REJECT_AE, povf),
+            (b_accept, R_ACCEPT_AE, povf | ac_ovf),
+            (b_aeresp, R_HANDLE_AERESP, jnp.asarray(False)),
         ]
         valid = jnp.asarray(False)
-        succ = s
         rank = jnp.int32(-1)
         ovf = jnp.asarray(False)
-        for b, sb, rk, ob in branches:
+        for b, rk, ob in branches:
             valid = valid | b
-            succ = jnp.where(b, sb, succ)
             rank = jnp.where(b, jnp.int32(rk), rank)
             ovf = ovf | (b & ob)
         return valid, succ, rank, ovf

@@ -1,0 +1,187 @@
+"""Bisect the _handle_message cost on the live backend (round 5).
+
+Progressive prefixes of the kernel body, each double-vmapped like
+production, timed with the pipelined device_get timer. Identifies which
+region owns the ~115 ms/chunk net cost that neither op count, bag sorts,
+nor [C, M, W] traffic explains.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import bag
+from raft_tpu.ops.packing import EMPTY
+
+
+def _sync(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    np.asarray(jax.device_get(leaves[0].ravel()[:1]))
+
+
+def timeit(name, fn, *args):
+    _sync(fn(*args))
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(4):
+            out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / 4)
+    print(f"{name:40s} {sorted(ts)[2]*1e3:9.1f} ms")
+
+
+def main():
+    from raft_tpu.utils.cfg import parse_cfg
+    from raft_tpu.models.registry import build_from_cfg
+    from raft_tpu.models.raft import NIL, RVREQ, RVRESP, AEREQ, AERESP, FOLLOWER, CANDIDATE
+
+    cfg = parse_cfg("/root/reference/specifications/standard-raft/Raft.cfg")
+    setup = build_from_cfg(cfg, msg_slots=32)
+    model = setup.model
+    p = model.p
+    L = p.max_log
+    C, W, M = 4096, model.layout.W, p.msg_slots
+    batch = jnp.zeros((C, W), jnp.int32)
+    marange = jnp.arange(M, dtype=jnp.int32)
+    packer = model.packer
+
+    def body(s, m, upto):
+        d = model._dec(s)
+        hi, lo, cnt = d["msg_hi"], d["msg_lo"], d["msg_cnt"]
+        khi, klo, kcnt = hi[m], lo[m], cnt[m]
+        occupied = khi != EMPTY
+        u = partial(packer.unpack, khi, klo)
+        mtype, mterm = u("mtype"), u("mterm")
+        src, dst = u("msource"), u("mdest")
+        ct_dst = d["currentTerm"][dst]
+        st_dst = d["state"][dst]
+        recv = occupied & (kcnt > 0)
+        b_upd = occupied & (mterm > ct_dst)
+        if upto == 1:  # decode + basic guards
+            return (b_upd | recv).astype(jnp.int32)
+        last_t = model._last_term(d, dst)
+        ll_dst = d["log_len"][dst]
+        rv_logok = (u("mlastLogTerm") > last_t) | (
+            (u("mlastLogTerm") == last_t) & (u("mlastLogIndex") >= ll_dst)
+        )
+        grant = (
+            (mterm == ct_dst) & rv_logok
+            & ((d["votedFor"][dst] == NIL) | (d["votedFor"][dst] == src + 1))
+        )
+        b_rvreq = recv & (mtype == RVREQ) & (mterm <= ct_dst)
+        b_rvresp = recv & (mtype == RVRESP) & (mterm == ct_dst)
+        prev_idx = u("mprevLogIndex")
+        prev_term = u("mprevLogTerm")
+        nent = u("nentries")
+        lt_row = d["log_term"][dst]
+        lv_row = d["log_value"][dst]
+        ae_logok = (prev_idx == 0) | (
+            (prev_idx > 0) & (prev_idx <= ll_dst)
+            & (prev_term == lt_row[jnp.clip(prev_idx - 1, 0, L - 1)])
+        )
+        b_reject = (
+            recv & (mtype == AEREQ) & (mterm <= ct_dst)
+            & ((mterm < ct_dst)
+               | ((mterm == ct_dst) & (st_dst == FOLLOWER) & ~ae_logok))
+        )
+        b_accept = (
+            recv & (mtype == AEREQ) & (mterm == ct_dst)
+            & ((st_dst == FOLLOWER) | (st_dst == CANDIDATE)) & ae_logok
+        )
+        b_aeresp = recv & (mtype == AERESP) & (mterm == ct_dst)
+        if upto == 2:  # + all branch guards
+            return (b_rvreq | b_rvresp | b_reject | b_accept | b_aeresp | grant).astype(jnp.int32)
+        can_append = (nent != 0) & (ll_dst == prev_idx)
+        needs_trunc = ((nent != 0) & (ll_dst >= prev_idx + 1)) | (
+            (nent == 0) & (ll_dst > prev_idx))
+        appending = can_append | (needs_trunc & (nent != 0))
+        new_ll = jnp.where(appending, prev_idx + 1,
+                           jnp.where(needs_trunc, prev_idx, ll_dst))
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        changes = appending | needs_trunc
+        keep = lanes < prev_idx
+        app_pos = jnp.clip(prev_idx, 0, L - 1)
+        nlt = jnp.where(keep, lt_row, 0).at[app_pos].set(
+            jnp.where(appending, u("eterm"), 0))
+        nlv = jnp.where(keep, lv_row, 0).at[app_pos].set(
+            jnp.where(appending, u("evalue"), 0))
+        nlt = jnp.where(changes, nlt, lt_row)
+        nlv = jnp.where(changes, nlv, lv_row)
+        if upto == 3:  # + accept log surgery
+            return nlt.sum() + nlv.sum() + new_ll
+        rhi, rlo = model._pack(mtype=RVRESP, mterm=ct_dst,
+                               mvoteGranted=grant.astype(jnp.int32),
+                               msource=dst, mdest=src)
+        rjhi, rjlo = model._pack(mtype=AERESP, mterm=ct_dst, msuccess=0,
+                                 mmatchIndex=0, msource=dst, mdest=src)
+        achi, aclo = model._pack(mtype=AERESP, mterm=ct_dst, msuccess=1,
+                                 mmatchIndex=prev_idx + nent,
+                                 msource=dst, mdest=src)
+        vg = jnp.where(
+            u("mvoteGranted") > 0,
+            d["votesGranted"].at[dst].set(
+                d["votesGranted"][dst] | (jnp.int32(1) << src)),
+            d["votesGranted"])
+        succm = u("msuccess") > 0
+        mmatch = u("mmatchIndex")
+        ni2 = jnp.where(
+            succm, d["nextIndex"].at[dst, src].set(mmatch + 1),
+            d["nextIndex"].at[dst, src].set(
+                jnp.maximum(d["nextIndex"][dst, src] - 1, 1)))
+        mi2 = jnp.where(succm, d["matchIndex"].at[dst, src].set(mmatch),
+                        d["matchIndex"])
+        if upto == 4:  # + packs, vg, ni/mi
+            return (rhi + rjhi + achi + vg.sum() + ni2.sum() + mi2.sum())
+        c2 = bag.bag_discard_at(cnt, m)
+        resp_hi = jnp.where(b_rvreq, rhi, jnp.where(b_reject, rjhi, achi))
+        resp_lo = jnp.where(b_rvreq, rlo, jnp.where(b_reject, rjlo, aclo))
+        phi, plo, pcnt, ex, povf = bag.bag_put(hi, lo, c2, resp_hi, resp_lo)
+        if upto == 5:  # + bag ops
+            return phi.sum() + plo.sum() + pcnt.sum() + ex
+        putb = b_rvreq | b_reject | b_accept
+        dropb = b_rvresp | b_aeresp
+        upd = dict(
+            currentTerm=jnp.where(b_upd, d["currentTerm"].at[dst].set(mterm),
+                                  d["currentTerm"]),
+            state=jnp.where(b_upd | b_accept,
+                            d["state"].at[dst].set(FOLLOWER), d["state"]),
+            votedFor=jnp.where(
+                b_upd, d["votedFor"].at[dst].set(NIL),
+                jnp.where(b_rvreq & grant,
+                          d["votedFor"].at[dst].set(src + 1), d["votedFor"])),
+            votesGranted=jnp.where(b_rvresp, vg, d["votesGranted"]),
+            commitIndex=jnp.where(
+                b_accept, d["commitIndex"].at[dst].set(u("mcommitIndex")),
+                d["commitIndex"]),
+            log_term=jnp.where(b_accept, d["log_term"].at[dst].set(nlt),
+                               d["log_term"]),
+            log_value=jnp.where(b_accept, d["log_value"].at[dst].set(nlv),
+                                d["log_value"]),
+            log_len=jnp.where(b_accept, d["log_len"].at[dst].set(new_ll),
+                              d["log_len"]),
+            nextIndex=jnp.where(b_aeresp, ni2, d["nextIndex"]),
+            matchIndex=jnp.where(b_aeresp, mi2, d["matchIndex"]),
+            msg_hi=jnp.where(putb, phi, hi),
+            msg_lo=jnp.where(putb, plo, lo),
+            msg_cnt=jnp.where(putb, pcnt, jnp.where(dropb, c2, cnt)),
+        )
+        succ = model._asm(d, **upd)
+        return succ.sum()
+
+    for upto in (1, 2, 3, 4, 5, 6):
+        fn = jax.jit(lambda b, upto=upto: jax.vmap(
+            lambda s: jax.vmap(lambda m: body(s, m, upto))(marange))(b))
+        timeit(f"upto={upto}", fn, batch)
+
+
+if __name__ == "__main__":
+    main()
