@@ -96,6 +96,14 @@ def main(argv=None):
                     help="message-bag slot count (default: per-spec)")
     ap.add_argument("--no-symmetry", action="store_true", help="ignore SYMMETRY")
     ap.add_argument(
+        "--trace-format",
+        default="default",
+        choices=["default", "tlc"],
+        help="counterexample trace format: tlc emits TLC's textual error-"
+        "trace shape (Error: headers + State N + /\\ var = value) for "
+        "offline bit-for-bit diffing against a real TLC run",
+    )
+    ap.add_argument(
         "--lenient",
         action="store_true",
         help="downgrade recoverable cfg bugs (e.g. PullRaft.cfg's undeclared "
@@ -298,9 +306,13 @@ def main(argv=None):
                 f"(walk {res.violation.walk}, depth {res.violation.depth})"
             )
             if res.trace:
-                from .utils.pprint import format_trace
+                from .utils.pprint import format_trace, format_trace_tlc
 
-                print(format_trace(res.trace, setup))
+                if args.trace_format == "tlc":
+                    print(format_trace_tlc(res.trace, setup,
+                                           res.violation.invariant))
+                else:
+                    print(format_trace(res.trace, setup))
             return 2
         print("no invariant violations (simulation is not exhaustive)")
         return 0
@@ -375,9 +387,12 @@ def main(argv=None):
         vdepth = res.depth if args.checker == "sharded" else res.violation.depth
         print(f"INVARIANT {viol_name} VIOLATED (depth {vdepth})")
         if res.trace:
-            from .utils.pprint import format_trace
+            from .utils.pprint import format_trace, format_trace_tlc
 
-            print(format_trace(res.trace, setup))
+            if args.trace_format == "tlc":
+                print(format_trace_tlc(res.trace, setup, viol_name))
+            else:
+                print(format_trace(res.trace, setup))
         return 2
     print("no invariant violations")
 
@@ -400,12 +415,20 @@ def main(argv=None):
                 f"({kind}; prefix {len(v.prefix) - 1} steps, "
                 f"loop {len(v.cycle)} steps)"
             )
-            from .utils.pprint import format_trace
+            from .utils.pprint import format_trace, format_trace_tlc
 
-            print(format_trace(v.prefix, setup))
-            if v.cycle:
-                print("-- loop (repeats forever) --")
-                print(format_trace(v.cycle, setup))
+            if args.trace_format == "tlc":
+                # TLC prints a temporal counterexample as one behavior
+                # with a "Back to state" marker at the loop entry
+                print(format_trace_tlc(v.prefix, setup, None))
+                if v.cycle:
+                    print("-- Back to state: the loop below repeats --")
+                    print(format_trace(v.cycle, setup))
+            else:
+                print(format_trace(v.prefix, setup))
+                if v.cycle:
+                    print("-- loop (repeats forever) --")
+                    print(format_trace(v.cycle, setup))
             return 2
         print("no temporal property violations")
     return 0

@@ -1,0 +1,56 @@
+"""Golden-file test for the TLC error-trace emitter (--trace-format tlc).
+
+The trace is the committed-value prefix of the documented split-brain
+history (standard-raft/README.md:86-150; tests/test_split_brain_regression.py
+replays the full behavior), replayed through the reconfig oracle and
+formatted in TLC's textual error-trace shape: `Error:` headers, then
+`State N: <action>` blocks of `/\\ var = value` lines in TLA+ value
+syntax. This is the artifact a JVM-equipped user diffs against a real
+`tlc` run (normalizing TLC's file line/col spans in action labels);
+the golden file locks the format.
+"""
+
+import os
+from types import SimpleNamespace
+
+from raft_tpu.oracle.reconfig_oracle import ReconfigRaftOracle
+from raft_tpu.utils.pprint import format_trace_tlc
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "split_brain_tlc.txt")
+
+
+def build_trace():
+    o = ReconfigRaftOracle(5, 1, 3, 1, 0, 1, 2, 2, 2, 5)
+    st = o.init_state()
+    trace = [("Initial predicate", st)]
+
+    def step(prefix, pick=None):
+        nonlocal st
+        for label, s2 in o.successors(st):
+            if label.startswith(prefix) and (pick is None or pick(s2)):
+                st = s2
+                trace.append((label, s2))
+                return
+        raise AssertionError(f"no successor matching {prefix!r}")
+
+    # the README's step-0 prefix: commit a client value on the initial
+    # cluster (majority {0, 2}; server 1 never receives it)
+    step("ClientRequest(0,0)")
+    step("AppendEntries(0,2)")
+    step("AcceptAppendEntriesRequest")
+    step("HandleAppendEntriesResponse")
+    step("AdvanceCommitIndex(0)")
+    assert st["acked"][0] is True
+    return trace
+
+
+def test_tlc_trace_matches_golden():
+    setup = SimpleNamespace(
+        server_names=["s1", "s2", "s3", "s4", "s5"], value_names=["v1"]
+    )
+    out = format_trace_tlc(build_trace(), setup, "LeaderHasAllAckedValues")
+    assert out.startswith("Error: Invariant LeaderHasAllAckedValues is violated.\n"
+                          "Error: The behavior up to this point is:\n")
+    with open(GOLDEN) as f:
+        want = f.read()
+    assert out == want, "TLC trace format drifted from the golden file"
