@@ -615,6 +615,175 @@ class ConfigRaftCommon:
             ovf = ovf | (b & ob)
         return valid, succ, rank, ovf
 
+    # ------------- shared Next-table + expansion (round-5 dedup) -------------
+    # Bindings and the fused expansion candidates follow the SAME order:
+    # Restart, RequestVote, BecomeLeader, ClientRequest, AdvanceCommit,
+    # AppendEntries, <variant config arms>, SendSnapshot, <variant
+    # pre-message arms>, HandleMessage — variants only supply the two
+    # hook pairs, so rank/label parity cannot drift between them.
+
+    def _config_bindings(self) -> list:
+        raise NotImplementedError  # variant reconfig arms
+
+    def _pre_msg_bindings(self) -> list:
+        return []
+
+    def _config_outs(self, s) -> list:
+        raise NotImplementedError
+
+    def _pre_msg_outs(self, s, iota_s) -> list:
+        return []
+
+    def _finish_init(self) -> None:
+        """Build bindings/expand/invariants/liveness (call at the end of
+        the variant __init__, after layout/packer/hook state exists)."""
+        import jax
+
+        p = self.p
+        S, V, M = p.n_servers, p.n_values, p.msg_slots
+        self._pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
+        b: list = []
+        for i in range(S):
+            b.append(("Restart", (i,)))
+        for i in range(S):
+            b.append(("RequestVote", (i,)))
+        for i in range(S):
+            b.append(("BecomeLeader", (i,)))
+        for i in range(S):
+            for v in range(V):
+                b.append(("ClientRequest", (i, v)))
+        for i in range(S):
+            b.append(("AdvanceCommitIndex", (i,)))
+        for ij in self._pairs:
+            b.append(("AppendEntries", ij))
+        b += self._config_bindings()
+        for ij in self._pairs:
+            b.append(("SendSnapshot", ij))
+        b += self._pre_msg_bindings()
+        for m in range(M):
+            b.append(("HandleMessage", (m,)))
+        self.bindings = b
+        self.A = len(b)
+        self.expand = jax.jit(jax.vmap(self._expand1))
+        from .base import messages_are_valid_kernel
+
+        self.invariants = {
+            "MessagesAreValid": jax.jit(
+                messages_are_valid_kernel(self.layout, self.packer)
+            ),
+            "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
+            "MaxOneReconfigurationAtATime": jax.jit(self._inv_max_one_reconfig),
+            "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
+            "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
+            "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
+        }
+        # ReconfigurationCompletes (JointConsensus :1039-1054 with the
+        # last-election-failed carve-out; AddRemove :990-1005, spec says
+        # run with MaxElections = 0). checker/liveness.py runs it.
+        self.liveness = {
+            "ReconfigurationCompletes": [
+                ("", jax.jit(self._live_reconfig_p),
+                 jax.jit(self._live_reconfig_q)),
+            ],
+        }
+
+    def _expand1(self, s):
+        import jax
+
+        p = self.p
+        S, V, M = p.n_servers, p.n_values, p.msg_slots
+        iota_s = jnp.arange(S, dtype=jnp.int32)
+        pr_i = jnp.asarray([ij[0] for ij in self._pairs], jnp.int32)
+        pr_j = jnp.asarray([ij[1] for ij in self._pairs], jnp.int32)
+        outs = []
+        outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
+        cr_i = jnp.repeat(iota_s, V)
+        cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
+        outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
+        outs.append(jax.vmap(lambda i: self._advance_commit_index(s, i))(iota_s))
+        outs.append(jax.vmap(lambda i, j: self._append_entries(s, i, j))(pr_i, pr_j))
+        outs += self._config_outs(s)
+        outs.append(jax.vmap(lambda i, j: self._send_snapshot(s, i, j))(pr_i, pr_j))
+        outs += self._pre_msg_outs(s, iota_s)
+        outs.append(
+            jax.vmap(lambda m: self._handle_message(s, m))(
+                jnp.arange(M, dtype=jnp.int32)
+            )
+        )
+        valid = jnp.concatenate([o[0] for o in outs])
+        succs = jnp.concatenate([o[1] for o in outs])
+        rank = jnp.concatenate([o[2] for o in outs])
+        ovf = jnp.concatenate([o[3] for o in outs])
+        return succs, valid, rank, ovf
+
+    # ------ shared AdvanceCommitIndex kernel (round-5 dedup; joint
+    # :613-653 dual-quorum, add/remove :605-642 member quorum) ---------
+
+    def _commit_quorum_ok(self, d, i, idxs, match_row, ks):
+        raise NotImplementedError  # [L] bool: quorum agrees at each idx
+
+    def _commit_config_upd(self, d, i, new_ci) -> dict:
+        raise NotImplementedError  # config re-derivation field updates
+
+    def _commit_removed(self, d, i, in_range):
+        raise NotImplementedError  # IsRemovedFromCluster over the window
+
+    def _advance_commit_index(self, s, i):
+        p = self.p
+        S, L, V = p.n_servers, p.max_log, p.n_values
+        d = self._dec(s)
+        ll_i = d["log_len"][i]
+        ci_i = d["commitIndex"][i]
+        match_row = d["matchIndex"][i]
+        idxs = jnp.arange(1, L + 1, dtype=jnp.int32)
+        ks = jnp.arange(S, dtype=jnp.int32)
+        quorum_ok = self._commit_quorum_ok(d, i, idxs, match_row, ks)
+        is_agree = quorum_ok & (idxs <= ll_i)
+        max_agree = jnp.max(jnp.where(is_agree, idxs, 0))
+        term_at = d["log_term"][i][jnp.clip(max_agree - 1, 0)]
+        new_ci = jnp.where(
+            (max_agree > 0) & (term_at == d["currentTerm"][i]), max_agree, ci_i
+        )
+        valid = (d["state"][i] == LEADER) & (ci_i < new_ci)
+        lanes = jnp.arange(L, dtype=jnp.int32)
+        in_range = (lanes + 1 > ci_i) & (lanes + 1 <= new_ci)
+        # MayBeAckClient: only AppendCommand entries can ack a value
+        vals_row = jnp.where(d["log_cmd"][i] == self.CMD_APPEND,
+                             d["log_val"][i], 0)
+        committed = jnp.any(
+            in_range[None, :]
+            & (vals_row[None, :] == jnp.arange(1, V + 1, dtype=jnp.int32)[:, None]),
+            axis=1,
+        )
+        acked = jnp.where((d["acked"] == ACK_FALSE) & committed, ACK_TRUE, d["acked"])
+        upd = self._commit_config_upd(d, i, new_ci)
+        upd["acked"] = acked
+        removed = self._commit_removed(d, i, in_range)
+        upd["state"] = jnp.where(
+            removed, d["state"].at[i].set(NOTMEMBER), d["state"])
+        upd["votesGranted"] = jnp.where(
+            removed, d["votesGranted"].at[i].set(0), d["votesGranted"]
+        )
+        upd["nextIndex"] = jnp.where(
+            removed,
+            d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
+            d["nextIndex"],
+        )
+        upd["matchIndex"] = jnp.where(
+            removed,
+            d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
+            d["matchIndex"],
+        )
+        upd["commitIndex"] = jnp.where(
+            removed,
+            d["commitIndex"].at[i].set(0),
+            d["commitIndex"].at[i].set(new_ci),
+        )
+        succ = self._asm(d, **upd)
+        return valid, succ, jnp.int32(R_ADVANCECOMMIT), jnp.asarray(False)
+
     def init_states(self) -> np.ndarray:
         """Init — :341-354: pre-installed cluster seeded with a
         NewConfigCommand; CHOOSE realized as lowest indices."""
@@ -703,4 +872,180 @@ class ConfigRaftCommon:
         elif mtype == SNAPRESP:
             kw.update(msuccess=int(d["msuccess"]), mmatchIndex=d["mmatchIndex"])
         return self.packer.pack(**kw)
+
+    # ---------------- host encode/decode (shared; round-5 dedup) ----------
+    # Variant hooks: ``counter_fields`` (spec-bounding counters beyond
+    # electionCtr/restartCtr), ``_decode_config``/``_encode_config`` (the
+    # per-server configuration tuples differ: joint carries old/new
+    # member sets, add/remove a single member set), and the per-entry
+    # ``_decode_entry``/``_encode_entry`` the log/message paths call.
+
+    counter_fields: tuple = ()
+
+    def _fs(self, mask) -> frozenset:
+        return frozenset(
+            j for j in range(self.p.n_servers) if (int(mask) >> j) & 1
+        )
+
+    def _decode_config(self, g):
+        raise NotImplementedError  # variant-specific config tuple schema
+
+    def _encode_config(self, vec, st) -> None:
+        raise NotImplementedError
+
+    def decode(self, vec: np.ndarray) -> dict:
+        lay, p = self.layout, self.p
+        g = lambda n: np.asarray(vec[lay.sl(n)])
+        S, L = p.n_servers, p.max_log
+        EF = self.ENTRY_FIELDS
+        rows = {n: g(f"log_{n}").reshape(S, L) for n in EF}
+        ll = g("log_len")
+        log = tuple(
+            tuple(
+                self._decode_entry(*(rows[n][i, k] for n in EF))
+                for k in range(int(ll[i]))
+            )
+            for i in range(S)
+        )
+        vg = g("votesGranted")
+        votes = tuple(
+            frozenset(j for j in range(S) if (int(vg[i]) >> j) & 1)
+            for i in range(S)
+        )
+        pr = g("pendingResponse")
+        pending = tuple(
+            tuple(bool((int(pr[i]) >> j) & 1) for j in range(S))
+            for i in range(S)
+        )
+        msgs = {}
+        word_arrs = [g(f"msg_w{k}") for k in range(self.n_words)]
+        cnt = g("msg_cnt")
+        for k in range(p.msg_slots):
+            if int(word_arrs[0][k]) == int(EMPTY):
+                continue
+            key = tuple(int(w[k]) for w in word_arrs)
+            msgs[self.decode_msg(key)] = int(cnt[k])
+        out = {
+            "config": self._decode_config(g),
+            "currentTerm": tuple(int(x) for x in g("currentTerm")),
+            "state": tuple(int(x) for x in g("state")),
+            "votedFor": tuple(
+                int(x) - 1 if x > 0 else None for x in g("votedFor")
+            ),
+            "votesGranted": votes,
+            "nextIndex": tuple(
+                tuple(int(x) for x in row) for row in g("nextIndex").reshape(S, S)
+            ),
+            "matchIndex": tuple(
+                tuple(int(x) for x in row) for row in g("matchIndex").reshape(S, S)
+            ),
+            "pendingResponse": pending,
+            "log": log,
+            "commitIndex": tuple(int(x) for x in g("commitIndex")),
+            "messages": frozenset(msgs.items()),
+            "acked": tuple(
+                {ACK_NIL: None, ACK_FALSE: False, ACK_TRUE: True}[int(x)]
+                for x in g("acked")
+            ),
+            "electionCtr": int(vec[lay.fields["electionCtr"].offset]),
+            "restartCtr": int(vec[lay.fields["restartCtr"].offset]),
+        }
+        for cname in self.counter_fields:
+            out[cname] = int(vec[lay.fields[cname].offset])
+        out["valueCtr"] = tuple(int(x) for x in g("valueCtr"))
+        return out
+
+    def decode_msg(self, key: tuple) -> tuple:
+        u = self.packer.unpack_all(key)
+        EF = self.ENTRY_FIELDS
+        mtype = int(u["mtype"])
+        rec = {
+            "mtype": MTYPE_NAMES[mtype],
+            "mterm": int(u["mterm"]),
+            "msource": int(u["msource"]),
+            "mdest": int(u["mdest"]),
+        }
+        if mtype == RVREQ:
+            rec["mlastLogTerm"] = int(u["mlastLogTerm"])
+            rec["mlastLogIndex"] = int(u["mlastLogIndex"])
+        elif mtype == RVRESP:
+            rec["mvoteGranted"] = bool(u["mvoteGranted"])
+        elif mtype == AEREQ:
+            rec["mprevLogIndex"] = int(u["mprevLogIndex"])
+            rec["mprevLogTerm"] = int(u["mprevLogTerm"])
+            rec["mentries"] = (
+                (self._decode_entry(*(u[f"e_{n}"] for n in EF)),)
+                if u["nentries"]
+                else ()
+            )
+            rec["mcommitIndex"] = int(u["mcommitIndex"])
+        elif mtype == AERESP:
+            rec["mresult"] = RC_NAMES[int(u["mresult"])]
+            rec["mmatchIndex"] = int(u["mmatchIndex"])
+        elif mtype == SNAPREQ:
+            ll = int(u["mloglen"])
+            rec["mlog"] = tuple(
+                self._decode_entry(*(u[f"l{k}_{n}"] for n in EF))
+                for k in range(ll)
+            )
+            rec["mcommitIndex"] = int(u["mcommitIndex"])
+            rec["mmembers"] = self._fs(u["mmembers"])
+        elif mtype == SNAPRESP:
+            rec["msuccess"] = bool(u["msuccess"])
+            rec["mmatchIndex"] = int(u["mmatchIndex"])
+        return tuple(sorted(rec.items()))
+
+    def encode(self, st: dict) -> np.ndarray:
+        lay, p = self.layout, self.p
+        S, L = p.n_servers, p.max_log
+        vec = lay.zeros(())
+        self._encode_config(vec, st)
+        vec[lay.sl("currentTerm")] = st["currentTerm"]
+        vec[lay.sl("state")] = st["state"]
+        vec[lay.sl("votedFor")] = [
+            0 if v is None else v + 1 for v in st["votedFor"]
+        ]
+        vec[lay.sl("votesGranted")] = [
+            sum(1 << j for j in vs) for vs in st["votesGranted"]
+        ]
+        rows = {n: np.zeros((S, L), np.int32) for n in self.ENTRY_FIELDS}
+        for i, lg in enumerate(st["log"]):
+            for k, e in enumerate(lg):
+                for n, v in self._encode_entry(e).items():
+                    rows[n][i, k] = v
+        for n in rows:
+            vec[lay.sl(f"log_{n}")] = rows[n].reshape(-1)
+        vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
+        vec[lay.sl("commitIndex")] = st["commitIndex"]
+        vec[lay.sl("nextIndex")] = np.asarray(st["nextIndex"]).reshape(-1)
+        vec[lay.sl("matchIndex")] = np.asarray(st["matchIndex"]).reshape(-1)
+        vec[lay.sl("pendingResponse")] = [
+            sum(1 << j for j, b in enumerate(row) if b)
+            for row in st["pendingResponse"]
+        ]
+        keys = sorted((self.encode_msg(rec), cnt) for rec, cnt in st["messages"])
+        if len(keys) > p.msg_slots:
+            raise OverflowError("message bag exceeds msg_slots")
+        word_arrs = [
+            np.full(p.msg_slots, int(EMPTY), np.int32)
+            for _ in range(self.n_words)
+        ]
+        cn = np.zeros(p.msg_slots, np.int32)
+        for k, (key, c) in enumerate(keys):
+            for w, arr in zip(key, word_arrs):
+                arr[k] = w
+            cn[k] = c
+        for k, arr in enumerate(word_arrs):
+            vec[lay.sl(f"msg_w{k}")] = arr
+        vec[lay.sl("msg_cnt")] = cn
+        vec[lay.sl("acked")] = [
+            {None: ACK_NIL, False: ACK_FALSE, True: ACK_TRUE}[a]
+            for a in st["acked"]
+        ]
+        vec[lay.fields["electionCtr"].offset] = st["electionCtr"]
+        vec[lay.fields["restartCtr"].offset] = st["restartCtr"]
+        for cname in self.counter_fields:
+            vec[lay.fields[cname].offset] = st[cname]
+        vec[lay.sl("valueCtr")] = st["valueCtr"]
+        return vec
 

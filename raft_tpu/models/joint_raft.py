@@ -300,50 +300,7 @@ class JointRaftModel(ConfigRaftCommon):
         self.msg_perm_spec = tuple(spec)
 
         self.shapes = reconfig_shapes(S, params.reconfig_type)
-        self.bindings: list[tuple[str, tuple]] = []
-        self._pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
-        for i in range(S):
-            self.bindings.append(("Restart", (i,)))
-        for i in range(S):
-            self.bindings.append(("RequestVote", (i,)))
-        for i in range(S):
-            self.bindings.append(("BecomeLeader", (i,)))
-        for i in range(S):
-            for v in range(V):
-                self.bindings.append(("ClientRequest", (i, v)))
-        for i in range(S):
-            self.bindings.append(("AdvanceCommitIndex", (i,)))
-        for ij in self._pairs:
-            self.bindings.append(("AppendEntries", ij))
-        for i in range(S):
-            for add_m, rem_m in self.shapes:
-                self.bindings.append(("AppendOldNewConfigToLog", (i, add_m, rem_m)))
-        for i in range(S):
-            self.bindings.append(("AppendNewConfigToLog", (i,)))
-        for ij in self._pairs:
-            self.bindings.append(("SendSnapshot", ij))
-        for m in range(M):
-            self.bindings.append(("HandleMessage", (m,)))
-        self.A = len(self.bindings)
-
-        self.expand = jax.jit(jax.vmap(self._expand1))
-        self.invariants = {
-            "MessagesAreValid": jax.jit(
-                messages_are_valid_kernel(self.layout, self.packer)
-            ),
-            "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
-            "MaxOneReconfigurationAtATime": jax.jit(self._inv_max_one_reconfig),
-            "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
-            "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
-            "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
-        }
-        # ReconfigurationCompletes — :1039-1054 (P ~> Q with the
-        # last-election-failed carve-out). checker/liveness.py runs it.
-        self.liveness = {
-            "ReconfigurationCompletes": [
-                ("", jax.jit(self._live_reconfig_p), jax.jit(self._live_reconfig_q)),
-            ],
-        }
+        self._finish_init()
 
     # ---------------- field access helpers ----------------
 
@@ -411,18 +368,10 @@ class JointRaftModel(ConfigRaftCommon):
         )
         return valid, succ, jnp.int32(J_BECOMELEADER), jnp.asarray(False)
 
-    def _advance_commit_index(self, s, i):
-        """AdvanceCommitIndex(i) — :613-653: dual-quorum agreement while
-        joint (:626-629)."""
-        p = self.p
-        S, L, V = p.n_servers, p.max_log, p.n_values
-        d = self._dec(s)
+    def _commit_quorum_ok(self, d, i, idxs, match_row, ks):
+        """Dual-quorum agreement while joint (:626-629)."""
+        S = self.p.n_servers
         joint = d["config_joint"][i] > 0
-        ll_i = d["log_len"][i]
-        ci_i = d["commitIndex"][i]
-        match_row = d["matchIndex"][i]
-        idxs = jnp.arange(1, L + 1, dtype=jnp.int32)
-        ks = jnp.arange(S, dtype=jnp.int32)
 
         def quorum_over(member_mask):
             member_k = ((member_mask >> ks) & 1) > 0
@@ -433,55 +382,21 @@ class JointRaftModel(ConfigRaftCommon):
 
         q_plain = quorum_over(d["config_members"][i])
         q_joint = quorum_over(d["config_old"][i]) & quorum_over(d["config_new"][i])
-        quorum_ok = jnp.where(joint, q_joint, q_plain)
-        is_agree = quorum_ok & (idxs <= ll_i)
-        max_agree = jnp.max(jnp.where(is_agree, idxs, 0))
-        term_at = d["log_term"][i][jnp.clip(max_agree - 1, 0)]
-        new_ci = jnp.where(
-            (max_agree > 0) & (term_at == d["currentTerm"][i]), max_agree, ci_i
-        )
-        valid = (d["state"][i] == LEADER) & (ci_i < new_ci)
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        in_range = (lanes + 1 > ci_i) & (lanes + 1 <= new_ci)
-        vals_row = jnp.where(d["log_cmd"][i] == CMD_APPEND, d["log_val"][i], 0)
-        committed = jnp.any(
-            in_range[None, :]
-            & (vals_row[None, :] == jnp.arange(1, V + 1, dtype=jnp.int32)[:, None]),
-            axis=1,
-        )
-        acked = jnp.where((d["acked"] == ACK_FALSE) & committed, ACK_TRUE, d["acked"])
+        return jnp.where(joint, q_joint, q_plain)
+
+    def _commit_config_upd(self, d, i, new_ci) -> dict:
         idx, cmd, cid, c_old, c_new, c_members = self._mrce(d, i)
-        upd = self._config_for_upd(
+        return self._config_for_upd(
             d, i, idx, cmd, cid, c_old, c_new, c_members, new_ci
         )
-        upd["acked"] = acked
-        # IsRemovedFromCluster (:606-611)
-        removed = jnp.any(
+
+    def _commit_removed(self, d, i, in_range):
+        """IsRemovedFromCluster (:606-611): NewConfigCommand without i."""
+        return jnp.any(
             in_range
             & (d["log_cmd"][i] == CMD_NEW)
             & (((d["log_members"][i] >> i) & 1) == 0)
         )
-        upd["state"] = jnp.where(removed, d["state"].at[i].set(NOTMEMBER), d["state"])
-        upd["votesGranted"] = jnp.where(
-            removed, d["votesGranted"].at[i].set(0), d["votesGranted"]
-        )
-        upd["nextIndex"] = jnp.where(
-            removed,
-            d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
-            d["nextIndex"],
-        )
-        upd["matchIndex"] = jnp.where(
-            removed,
-            d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
-            d["matchIndex"],
-        )
-        upd["commitIndex"] = jnp.where(
-            removed,
-            d["commitIndex"].at[i].set(0),
-            d["commitIndex"].at[i].set(new_ci),
-        )
-        succ = self._asm(d, **upd)
-        return valid, succ, jnp.int32(J_ADVANCECOMMIT), jnp.asarray(False)
 
     def _append_old_new(self, s, i, add_mask, rem_mask):
         """AppendOldNewConfigToLog(i) for one admitted (add, remove) subset
@@ -613,21 +528,20 @@ class JointRaftModel(ConfigRaftCommon):
 
     # ---------------- full expansion ----------------
 
-    def _expand1(self, s):
-        p = self.p
-        S, V, M = p.n_servers, p.n_values, p.msg_slots
+    def _config_bindings(self) -> list:
+        b = []
+        for i in range(self.p.n_servers):
+            for add_m, rem_m in self.shapes:
+                b.append(("AppendOldNewConfigToLog", (i, add_m, rem_m)))
+        for i in range(self.p.n_servers):
+            b.append(("AppendNewConfigToLog", (i,)))
+        return b
+
+    def _config_outs(self, s) -> list:
+        import jax
+
+        S = self.p.n_servers
         iota_s = jnp.arange(S, dtype=jnp.int32)
-        pr_i = jnp.asarray([ij[0] for ij in self._pairs], jnp.int32)
-        pr_j = jnp.asarray([ij[1] for ij in self._pairs], jnp.int32)
-        outs = []
-        outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
-        cr_i = jnp.repeat(iota_s, V)
-        cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
-        outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
-        outs.append(jax.vmap(lambda i: self._advance_commit_index(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i, j: self._append_entries(s, i, j))(pr_i, pr_j))
         on_i = jnp.asarray(
             [i for i in range(S) for _ in self.shapes], jnp.int32
         )
@@ -637,25 +551,12 @@ class JointRaftModel(ConfigRaftCommon):
         on_rem = jnp.asarray(
             [r for _ in range(S) for _a, r in self.shapes], jnp.int32
         )
-        outs.append(
+        return [
             jax.vmap(lambda i, a, r: self._append_old_new(s, i, a, r))(
                 on_i, on_add, on_rem
-            )
-        )
-        outs.append(jax.vmap(lambda i: self._append_new(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i, j: self._send_snapshot(s, i, j))(pr_i, pr_j))
-        outs.append(
-            jax.vmap(lambda m: self._handle_message(s, m))(
-                jnp.arange(M, dtype=jnp.int32)
-            )
-        )
-        valid = jnp.concatenate([o[0] for o in outs])
-        succs = jnp.concatenate([o[1] for o in outs])
-        rank = jnp.concatenate([o[2] for o in outs])
-        ovf = jnp.concatenate([o[3] for o in outs])
-        return succs, valid, rank, ovf
-
-    # ---------------- initial states ----------------
+            ),
+            jax.vmap(lambda i: self._append_new(s, i))(iota_s),
+        ]
 
     def _old_new_committed(self, states):
         """OldNewCommitted(i, index) over all (i, lane): committed
@@ -776,11 +677,6 @@ class JointRaftModel(ConfigRaftCommon):
 
     # ---------------- host-side decode/encode ----------------
 
-    def _fs(self, mask) -> frozenset:
-        return frozenset(
-            j for j in range(self.p.n_servers) if (int(mask) >> j) & 1
-        )
-
     def _decode_entry(self, term, cmd, val, cid, old, new, members):
         cmd_name = CMD_NAMES[int(cmd)]
         if cmd_name == "AppendCommand":
@@ -810,28 +706,10 @@ class JointRaftModel(ConfigRaftCommon):
             new=mk(val[2]), members=mk(val[3]),
         )
 
-    def decode(self, vec: np.ndarray) -> dict:
-        lay, p = self.layout, self.p
-        g = lambda n: np.asarray(vec[lay.sl(n)])
-        S, L = p.n_servers, p.max_log
-        rows = {n: g(f"log_{n}").reshape(S, L) for n in ENTRY_FIELDS}
-        ll = g("log_len")
-        log = tuple(
-            tuple(
-                self._decode_entry(*(rows[n][i, k] for n in ENTRY_FIELDS))
-                for k in range(int(ll[i]))
-            )
-            for i in range(S)
-        )
-        vg = g("votesGranted")
-        votes = tuple(
-            frozenset(j for j in range(S) if (int(vg[i]) >> j) & 1) for i in range(S)
-        )
-        pr = g("pendingResponse")
-        pending = tuple(
-            tuple(bool((int(pr[i]) >> j) & 1) for j in range(S)) for i in range(S)
-        )
-        config = tuple(
+    counter_fields = ("reconfigCtr",)
+
+    def _decode_config(self, g):
+        return tuple(
             (
                 int(g("config_id")[i]),
                 bool(g("config_joint")[i]),
@@ -840,133 +718,18 @@ class JointRaftModel(ConfigRaftCommon):
                 self._fs(g("config_new")[i]),
                 bool(g("config_committed")[i]),
             )
-            for i in range(S)
+            for i in range(self.p.n_servers)
         )
-        msgs = {}
-        word_arrs = [g(f"msg_w{k}") for k in range(self.n_words)]
-        cnt = g("msg_cnt")
-        for k in range(p.msg_slots):
-            if int(word_arrs[0][k]) == int(EMPTY):
-                continue
-            key = tuple(int(w[k]) for w in word_arrs)
-            msgs[self.decode_msg(key)] = int(cnt[k])
-        return {
-            "config": config,
-            "currentTerm": tuple(int(x) for x in g("currentTerm")),
-            "state": tuple(int(x) for x in g("state")),
-            "votedFor": tuple(int(x) - 1 if x > 0 else None for x in g("votedFor")),
-            "votesGranted": votes,
-            "nextIndex": tuple(
-                tuple(int(x) for x in row) for row in g("nextIndex").reshape(S, S)
-            ),
-            "matchIndex": tuple(
-                tuple(int(x) for x in row) for row in g("matchIndex").reshape(S, S)
-            ),
-            "pendingResponse": pending,
-            "log": log,
-            "commitIndex": tuple(int(x) for x in g("commitIndex")),
-            "messages": frozenset(msgs.items()),
-            "acked": tuple(
-                {ACK_NIL: None, ACK_FALSE: False, ACK_TRUE: True}[int(x)]
-                for x in g("acked")
-            ),
-            "electionCtr": int(vec[lay.fields["electionCtr"].offset]),
-            "restartCtr": int(vec[lay.fields["restartCtr"].offset]),
-            "reconfigCtr": int(vec[lay.fields["reconfigCtr"].offset]),
-            "valueCtr": tuple(int(x) for x in g("valueCtr")),
-        }
 
-    def decode_msg(self, key: tuple) -> tuple:
-        u = self.packer.unpack_all(key)
-        mtype = int(u["mtype"])
-        rec = {
-            "mtype": MTYPE_NAMES[mtype],
-            "mterm": int(u["mterm"]),
-            "msource": int(u["msource"]),
-            "mdest": int(u["mdest"]),
-        }
-        if mtype == RVREQ:
-            rec["mlastLogTerm"] = int(u["mlastLogTerm"])
-            rec["mlastLogIndex"] = int(u["mlastLogIndex"])
-        elif mtype == RVRESP:
-            rec["mvoteGranted"] = bool(u["mvoteGranted"])
-        elif mtype == AEREQ:
-            rec["mprevLogIndex"] = int(u["mprevLogIndex"])
-            rec["mprevLogTerm"] = int(u["mprevLogTerm"])
-            rec["mentries"] = (
-                (self._decode_entry(*(u[f"e_{n}"] for n in ENTRY_FIELDS)),)
-                if u["nentries"]
-                else ()
-            )
-            rec["mcommitIndex"] = int(u["mcommitIndex"])
-        elif mtype == AERESP:
-            rec["mresult"] = RC_NAMES[int(u["mresult"])]
-            rec["mmatchIndex"] = int(u["mmatchIndex"])
-        elif mtype == SNAPREQ:
-            ll = int(u["mloglen"])
-            rec["mlog"] = tuple(
-                self._decode_entry(*(u[f"l{k}_{n}"] for n in ENTRY_FIELDS))
-                for k in range(ll)
-            )
-            rec["mcommitIndex"] = int(u["mcommitIndex"])
-            rec["mmembers"] = self._fs(u["mmembers"])
-        elif mtype == SNAPRESP:
-            rec["msuccess"] = bool(u["msuccess"])
-            rec["mmatchIndex"] = int(u["mmatchIndex"])
-        return tuple(sorted(rec.items()))
-
-    def encode(self, st: dict) -> np.ndarray:
-        lay, p = self.layout, self.p
-        S, L = p.n_servers, p.max_log
+    def _encode_config(self, vec, st) -> None:
+        lay = self.layout
         mk = lambda fs: sum(1 << j for j in fs)
-        vec = lay.zeros(())
         vec[lay.sl("config_id")] = [c[0] for c in st["config"]]
         vec[lay.sl("config_joint")] = [int(c[1]) for c in st["config"]]
         vec[lay.sl("config_members")] = [mk(c[2]) for c in st["config"]]
         vec[lay.sl("config_old")] = [mk(c[3]) for c in st["config"]]
         vec[lay.sl("config_new")] = [mk(c[4]) for c in st["config"]]
         vec[lay.sl("config_committed")] = [int(c[5]) for c in st["config"]]
-        vec[lay.sl("currentTerm")] = st["currentTerm"]
-        vec[lay.sl("state")] = st["state"]
-        vec[lay.sl("votedFor")] = [0 if v is None else v + 1 for v in st["votedFor"]]
-        vec[lay.sl("votesGranted")] = [mk(vs) for vs in st["votesGranted"]]
-        rows = {n: np.zeros((S, L), np.int32) for n in ENTRY_FIELDS}
-        for i, lg in enumerate(st["log"]):
-            for k, e in enumerate(lg):
-                for n, v in self._encode_entry(e).items():
-                    rows[n][i, k] = v
-        for n in rows:
-            vec[lay.sl(f"log_{n}")] = rows[n].reshape(-1)
-        vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
-        vec[lay.sl("commitIndex")] = st["commitIndex"]
-        vec[lay.sl("nextIndex")] = np.asarray(st["nextIndex"]).reshape(-1)
-        vec[lay.sl("matchIndex")] = np.asarray(st["matchIndex"]).reshape(-1)
-        vec[lay.sl("pendingResponse")] = [
-            sum(1 << j for j, b in enumerate(row) if b)
-            for row in st["pendingResponse"]
-        ]
-        keys = sorted((self.encode_msg(rec), cnt) for rec, cnt in st["messages"])
-        if len(keys) > p.msg_slots:
-            raise OverflowError("message bag exceeds msg_slots")
-        word_arrs = [
-            np.full(p.msg_slots, int(EMPTY), np.int32) for _ in range(self.n_words)
-        ]
-        cn = np.zeros(p.msg_slots, np.int32)
-        for k, (key, c) in enumerate(keys):
-            for w, arr in zip(key, word_arrs):
-                arr[k] = w
-            cn[k] = c
-        for k, arr in enumerate(word_arrs):
-            vec[lay.sl(f"msg_w{k}")] = arr
-        vec[lay.sl("msg_cnt")] = cn
-        vec[lay.sl("acked")] = [
-            {None: ACK_NIL, False: ACK_FALSE, True: ACK_TRUE}[a] for a in st["acked"]
-        ]
-        vec[lay.fields["electionCtr"].offset] = st["electionCtr"]
-        vec[lay.fields["restartCtr"].offset] = st["restartCtr"]
-        vec[lay.fields["reconfigCtr"].offset] = st["reconfigCtr"]
-        vec[lay.sl("valueCtr")] = st["valueCtr"]
-        return vec
 
 
 @lru_cache(maxsize=None)

@@ -280,52 +280,8 @@ class ReconfigRaftModel(ConfigRaftCommon):
 
         # Candidate table: non-receipt disjuncts in Next order (:943-965),
         # receipt disjuncts fused per slot at the end.
-        self.bindings: list[tuple[str, tuple]] = []
-        self._pairs = [(i, j) for i in range(S) for j in range(S) if i != j]
         self._all_pairs = [(i, j) for i in range(S) for j in range(S)]
-        for i in range(S):
-            self.bindings.append(("Restart", (i,)))
-        for i in range(S):
-            self.bindings.append(("RequestVote", (i,)))
-        for i in range(S):
-            self.bindings.append(("BecomeLeader", (i,)))
-        for i in range(S):
-            for v in range(V):
-                self.bindings.append(("ClientRequest", (i, v)))
-        for i in range(S):
-            self.bindings.append(("AdvanceCommitIndex", (i,)))
-        for ij in self._pairs:
-            self.bindings.append(("AppendEntries", ij))
-        for ij in self._all_pairs:
-            self.bindings.append(("AppendAddServerCommandToLog", ij))
-        for ij in self._all_pairs:
-            self.bindings.append(("AppendRemoveServerCommandToLog", ij))
-        for ij in self._pairs:
-            self.bindings.append(("SendSnapshot", ij))
-        for i in range(S):
-            self.bindings.append(("ResetWithSameIdentity", (i,)))
-        for m in range(M):
-            self.bindings.append(("HandleMessage", (m,)))
-        self.A = len(self.bindings)
-
-        self.expand = jax.jit(jax.vmap(self._expand1))
-        self.invariants = {
-            "MessagesAreValid": jax.jit(
-                messages_are_valid_kernel(self.layout, self.packer)
-            ),
-            "NoLogDivergence": jax.jit(self._inv_no_log_divergence),
-            "MaxOneReconfigurationAtATime": jax.jit(self._inv_max_one_reconfig),
-            "LeaderHasAllAckedValues": jax.jit(self._inv_leader_has_acked),
-            "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
-            "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
-        }
-        # ReconfigurationCompletes — :990-1005 (P ~> Q; the spec warns to
-        # use it with MaxElections = 0, :988). checker/liveness.py runs it.
-        self.liveness = {
-            "ReconfigurationCompletes": [
-                ("", jax.jit(self._live_reconfig_p), jax.jit(self._live_reconfig_q)),
-            ],
-        }
+        self._finish_init()
 
     # ---------------- field access helpers ----------------
 
@@ -365,79 +321,33 @@ class ReconfigRaftModel(ConfigRaftCommon):
         )
         return valid, succ, jnp.int32(A_BECOMELEADER), jnp.asarray(False)
 
-    def _advance_commit_index(self, s, i):
-        """AdvanceCommitIndex(i) — :605-642: member-set quorum with leader
-        self-exclusion (:612-615); derives config; leaves the cluster on
-        committing its own removal (:633-640)."""
-        p = self.p
-        S, L, V = p.n_servers, p.max_log, p.n_values
-        d = self._dec(s)
+    def _commit_quorum_ok(self, d, i, idxs, match_row, ks):
+        """Member-set quorum with leader self-inclusion (:612-618)."""
+        S = self.p.n_servers
         members = d["config_members"][i]
-        n_members = self._popcount(members, S)
-        ll_i = d["log_len"][i]
-        ci_i = d["commitIndex"][i]
-        match_row = d["matchIndex"][i]
-        idxs = jnp.arange(1, L + 1, dtype=jnp.int32)
-        ks = jnp.arange(S, dtype=jnp.int32)
         member_k = ((members >> ks) & 1) > 0  # [S]
         in_agree = member_k[None, :] & (
             (match_row[None, :] >= idxs[:, None]) | (ks[None, :] == i)
         )
-        agree_cnt = jnp.sum(in_agree, axis=1)
-        quorum_ok = 2 * agree_cnt > n_members
-        is_agree = quorum_ok & (idxs <= ll_i)
-        max_agree = jnp.max(jnp.where(is_agree, idxs, 0))
-        term_at = d["log_term"][i][jnp.clip(max_agree - 1, 0)]
-        new_ci = jnp.where(
-            (max_agree > 0) & (term_at == d["currentTerm"][i]), max_agree, ci_i
-        )
-        valid = (d["state"][i] == LEADER) & (ci_i < new_ci)
-        lanes = jnp.arange(L, dtype=jnp.int32)
-        in_range = (lanes + 1 > ci_i) & (lanes + 1 <= new_ci)
-        # MayBeAckClient (:587-596): only AppendCommand entries
-        vals_row = jnp.where(d["log_cmd"][i] == CMD_APPEND, d["log_val"][i], 0)
-        committed = jnp.any(
-            in_range[None, :] & (vals_row[None, :] == jnp.arange(1, V + 1, dtype=jnp.int32)[:, None]),
-            axis=1,
-        )
-        acked = jnp.where((d["acked"] == ACK_FALSE) & committed, ACK_TRUE, d["acked"])
-        # config re-derivation (:627-632)
+        return 2 * jnp.sum(in_agree, axis=1) > self._popcount(members, S)
+
+    def _commit_config_upd(self, d, i, new_ci) -> dict:
+        """Config re-derivation (:627-632)."""
         cfg_idx, cfg_id, cfg_members = self._mrce(d, i)
         cfg_committed = (new_ci >= cfg_idx).astype(jnp.int32)
-        # IsRemovedFromCluster (:598-603)
-        removed = jnp.any(
-            in_range
-            & (d["log_cmd"][i] == CMD_REMOVE)
-            & (((d["log_cmembers"][i] >> i) & 1) == 0)
-        )
-        upd = dict(
-            acked=acked,
+        return dict(
             config_id=d["config_id"].at[i].set(cfg_id),
             config_members=d["config_members"].at[i].set(cfg_members),
             config_committed=d["config_committed"].at[i].set(cfg_committed),
         )
-        st_rm = d["state"].at[i].set(NOTMEMBER)
-        upd["state"] = jnp.where(removed, st_rm, d["state"])
-        upd["votesGranted"] = jnp.where(
-            removed, d["votesGranted"].at[i].set(0), d["votesGranted"]
+
+    def _commit_removed(self, d, i, in_range):
+        """IsRemovedFromCluster (:598-603)."""
+        return jnp.any(
+            in_range
+            & (d["log_cmd"][i] == CMD_REMOVE)
+            & (((d["log_cmembers"][i] >> i) & 1) == 0)
         )
-        upd["nextIndex"] = jnp.where(
-            removed,
-            d["nextIndex"].at[i].set(jnp.ones((S,), jnp.int32)),
-            d["nextIndex"],
-        )
-        upd["matchIndex"] = jnp.where(
-            removed,
-            d["matchIndex"].at[i].set(jnp.zeros((S,), jnp.int32)),
-            d["matchIndex"],
-        )
-        upd["commitIndex"] = jnp.where(
-            removed,
-            d["commitIndex"].at[i].set(0),
-            d["commitIndex"].at[i].set(new_ci),
-        )
-        succ = self._asm(d, **upd)
-        return valid, succ, jnp.int32(A_ADVANCECOMMIT), jnp.asarray(False)
 
     def _append_add(self, s, i, a):
         """AppendAddServerCommandToLog(i, a) — :795-824."""
@@ -592,41 +502,34 @@ class ReconfigRaftModel(ConfigRaftCommon):
 
     # ---------------- full expansion ----------------
 
-    def _expand1(self, s):
-        p = self.p
-        S, V, M = p.n_servers, p.n_values, p.msg_slots
-        iota_s = jnp.arange(S, dtype=jnp.int32)
-        pr_i = jnp.asarray([ij[0] for ij in self._pairs], jnp.int32)
-        pr_j = jnp.asarray([ij[1] for ij in self._pairs], jnp.int32)
+    def _config_bindings(self) -> list:
+        b = []
+        for ij in self._all_pairs:
+            b.append(("AppendAddServerCommandToLog", ij))
+        for ij in self._all_pairs:
+            b.append(("AppendRemoveServerCommandToLog", ij))
+        return b
+
+    def _pre_msg_bindings(self) -> list:
+        return [("ResetWithSameIdentity", (i,))
+                for i in range(self.p.n_servers)]
+
+    def _config_outs(self, s) -> list:
+        import jax
+
         ap_i = jnp.asarray([ij[0] for ij in self._all_pairs], jnp.int32)
         ap_j = jnp.asarray([ij[1] for ij in self._all_pairs], jnp.int32)
-        outs = []
-        outs.append(jax.vmap(lambda i: self._restart(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i: self._request_vote(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i: self._become_leader(s, i))(iota_s))
-        cr_i = jnp.repeat(iota_s, V)
-        cr_v = jnp.tile(jnp.arange(V, dtype=jnp.int32), S)
-        outs.append(jax.vmap(lambda i, v: self._client_request(s, i, v))(cr_i, cr_v))
-        outs.append(jax.vmap(lambda i: self._advance_commit_index(s, i))(iota_s))
-        outs.append(jax.vmap(lambda i, j: self._append_entries(s, i, j))(pr_i, pr_j))
-        outs.append(jax.vmap(lambda i, a: self._append_add(s, i, a))(ap_i, ap_j))
-        outs.append(jax.vmap(lambda i, r: self._append_remove(s, i, r))(ap_i, ap_j))
-        outs.append(jax.vmap(lambda i, j: self._send_snapshot(s, i, j))(pr_i, pr_j))
-        outs.append(
-            jax.vmap(lambda i: self._reset_with_same_identity(s, i))(iota_s)
-        )
-        outs.append(
-            jax.vmap(lambda m: self._handle_message(s, m))(
-                jnp.arange(M, dtype=jnp.int32)
-            )
-        )
-        valid = jnp.concatenate([o[0] for o in outs])
-        succs = jnp.concatenate([o[1] for o in outs])
-        rank = jnp.concatenate([o[2] for o in outs])
-        ovf = jnp.concatenate([o[3] for o in outs])
-        return succs, valid, rank, ovf
+        return [
+            jax.vmap(lambda i, a: self._append_add(s, i, a))(ap_i, ap_j),
+            jax.vmap(lambda i, r: self._append_remove(s, i, r))(ap_i, ap_j),
+        ]
 
-    # ---------------- initial states ----------------
+    def _pre_msg_outs(self, s, iota_s) -> list:
+        import jax
+
+        return [
+            jax.vmap(lambda i: self._reset_with_same_identity(s, i))(iota_s)
+        ]
 
     def _live_reconfig_p(self, states):
         """ReconfigurationCompletes antecedent — :992-996: some leader has
@@ -745,182 +648,25 @@ class ReconfigRaftModel(ConfigRaftCommon):
             cmembers=sum(1 << j for j in val[2]),
         )
 
-    def decode(self, vec: np.ndarray) -> dict:
-        lay, p = self.layout, self.p
-        g = lambda n: np.asarray(vec[lay.sl(n)])
-        S, L = p.n_servers, p.max_log
-        rows = {
-            n: g(f"log_{n}").reshape(S, L)
-            for n in ENTRY_FIELDS
-        }
-        ll = g("log_len")
-        log = tuple(
-            tuple(
-                self._decode_entry(
-                    rows["term"][i, k], rows["cmd"][i, k], rows["val"][i, k],
-                    rows["cid"][i, k], rows["cmem"][i, k], rows["cmembers"][i, k],
-                )
-                for k in range(int(ll[i]))
-            )
-            for i in range(S)
-        )
-        vg = g("votesGranted")
-        votes = tuple(
-            frozenset(j for j in range(S) if (int(vg[i]) >> j) & 1) for i in range(S)
-        )
-        pr = g("pendingResponse")
-        pending = tuple(
-            tuple(bool((int(pr[i]) >> j) & 1) for j in range(S)) for i in range(S)
-        )
-        cm = g("config_members")
-        config = tuple(
+    counter_fields = ("addReconfigCtr", "removeReconfigCtr")
+
+    def _decode_config(self, g):
+        return tuple(
             (
                 int(g("config_id")[i]),
-                frozenset(j for j in range(S) if (int(cm[i]) >> j) & 1),
+                self._fs(g("config_members")[i]),
                 bool(g("config_committed")[i]),
             )
-            for i in range(S)
+            for i in range(self.p.n_servers)
         )
-        msgs = {}
-        word_arrs = [g(f"msg_w{k}") for k in range(self.n_words)]
-        cnt = g("msg_cnt")
-        for k in range(p.msg_slots):
-            if int(word_arrs[0][k]) == int(EMPTY):
-                continue
-            key = tuple(int(w[k]) for w in word_arrs)
-            msgs[self.decode_msg(key)] = int(cnt[k])
-        return {
-            "config": config,
-            "currentTerm": tuple(int(x) for x in g("currentTerm")),
-            "state": tuple(int(x) for x in g("state")),
-            "votedFor": tuple(int(x) - 1 if x > 0 else None for x in g("votedFor")),
-            "votesGranted": votes,
-            "nextIndex": tuple(
-                tuple(int(x) for x in row) for row in g("nextIndex").reshape(S, S)
-            ),
-            "matchIndex": tuple(
-                tuple(int(x) for x in row) for row in g("matchIndex").reshape(S, S)
-            ),
-            "pendingResponse": pending,
-            "log": log,
-            "commitIndex": tuple(int(x) for x in g("commitIndex")),
-            "messages": frozenset(msgs.items()),
-            "acked": tuple(
-                {ACK_NIL: None, ACK_FALSE: False, ACK_TRUE: True}[int(x)]
-                for x in g("acked")
-            ),
-            "electionCtr": int(vec[lay.fields["electionCtr"].offset]),
-            "restartCtr": int(vec[lay.fields["restartCtr"].offset]),
-            "addReconfigCtr": int(vec[lay.fields["addReconfigCtr"].offset]),
-            "removeReconfigCtr": int(vec[lay.fields["removeReconfigCtr"].offset]),
-            "valueCtr": tuple(int(x) for x in g("valueCtr")),
-        }
 
-    def decode_msg(self, key: tuple) -> tuple:
-        u = self.packer.unpack_all(key)
-        mtype = int(u["mtype"])
-        rec = {
-            "mtype": MTYPE_NAMES[mtype],
-            "mterm": int(u["mterm"]),
-            "msource": int(u["msource"]),
-            "mdest": int(u["mdest"]),
-        }
-        if mtype == RVREQ:
-            rec["mlastLogTerm"] = int(u["mlastLogTerm"])
-            rec["mlastLogIndex"] = int(u["mlastLogIndex"])
-        elif mtype == RVRESP:
-            rec["mvoteGranted"] = bool(u["mvoteGranted"])
-        elif mtype == AEREQ:
-            rec["mprevLogIndex"] = int(u["mprevLogIndex"])
-            rec["mprevLogTerm"] = int(u["mprevLogTerm"])
-            rec["mentries"] = (
-                (
-                    self._decode_entry(
-                        u["e_term"], u["e_cmd"], u["e_val"], u["e_cid"],
-                        u["e_cmem"], u["e_cmembers"],
-                    ),
-                )
-                if u["nentries"]
-                else ()
-            )
-            rec["mcommitIndex"] = int(u["mcommitIndex"])
-        elif mtype == AERESP:
-            rec["mresult"] = RC_NAMES[int(u["mresult"])]
-            rec["mmatchIndex"] = int(u["mmatchIndex"])
-        elif mtype == SNAPREQ:
-            ll = int(u["mloglen"])
-            rec["mlog"] = tuple(
-                self._decode_entry(
-                    u[f"l{k}_term"], u[f"l{k}_cmd"], u[f"l{k}_val"],
-                    u[f"l{k}_cid"], u[f"l{k}_cmem"], u[f"l{k}_cmembers"],
-                )
-                for k in range(ll)
-            )
-            rec["mcommitIndex"] = int(u["mcommitIndex"])
-            rec["mmembers"] = frozenset(
-                j for j in range(self.p.n_servers) if (int(u["mmembers"]) >> j) & 1
-            )
-        elif mtype == SNAPRESP:
-            rec["msuccess"] = bool(u["msuccess"])
-            rec["mmatchIndex"] = int(u["mmatchIndex"])
-        return tuple(sorted(rec.items()))
-
-    def encode(self, st: dict) -> np.ndarray:
-        lay, p = self.layout, self.p
-        S, L = p.n_servers, p.max_log
-        vec = lay.zeros(())
+    def _encode_config(self, vec, st) -> None:
+        lay = self.layout
         vec[lay.sl("config_id")] = [c[0] for c in st["config"]]
         vec[lay.sl("config_members")] = [
             sum(1 << j for j in c[1]) for c in st["config"]
         ]
         vec[lay.sl("config_committed")] = [int(c[2]) for c in st["config"]]
-        vec[lay.sl("currentTerm")] = st["currentTerm"]
-        vec[lay.sl("state")] = st["state"]
-        vec[lay.sl("votedFor")] = [0 if v is None else v + 1 for v in st["votedFor"]]
-        vec[lay.sl("votesGranted")] = [
-            sum(1 << j for j in vs) for vs in st["votesGranted"]
-        ]
-        rows = {
-            n: np.zeros((S, L), np.int32)
-            for n in ENTRY_FIELDS
-        }
-        for i, lg in enumerate(st["log"]):
-            for k, e in enumerate(lg):
-                for n, v in self._encode_entry(e).items():
-                    rows[n][i, k] = v
-        for n in rows:
-            vec[lay.sl(f"log_{n}")] = rows[n].reshape(-1)
-        vec[lay.sl("log_len")] = [len(lg) for lg in st["log"]]
-        vec[lay.sl("commitIndex")] = st["commitIndex"]
-        vec[lay.sl("nextIndex")] = np.asarray(st["nextIndex"]).reshape(-1)
-        vec[lay.sl("matchIndex")] = np.asarray(st["matchIndex"]).reshape(-1)
-        vec[lay.sl("pendingResponse")] = [
-            sum(1 << j for j, b in enumerate(row) if b)
-            for row in st["pendingResponse"]
-        ]
-        keys = sorted((self.encode_msg(rec), cnt) for rec, cnt in st["messages"])
-        if len(keys) > p.msg_slots:
-            raise OverflowError("message bag exceeds msg_slots")
-        word_arrs = [
-            np.full(p.msg_slots, int(EMPTY), np.int32) for _ in range(self.n_words)
-        ]
-        cn = np.zeros(p.msg_slots, np.int32)
-        for k, (key, c) in enumerate(keys):
-            for w, arr in zip(key, word_arrs):
-                arr[k] = w
-            cn[k] = c
-        for k, arr in enumerate(word_arrs):
-            vec[lay.sl(f"msg_w{k}")] = arr
-        vec[lay.sl("msg_cnt")] = cn
-        vec[lay.sl("acked")] = [
-            {None: ACK_NIL, False: ACK_FALSE, True: ACK_TRUE}[a] for a in st["acked"]
-        ]
-        vec[lay.fields["electionCtr"].offset] = st["electionCtr"]
-        vec[lay.fields["restartCtr"].offset] = st["restartCtr"]
-        vec[lay.fields["addReconfigCtr"].offset] = st["addReconfigCtr"]
-        vec[lay.fields["removeReconfigCtr"].offset] = st["removeReconfigCtr"]
-        vec[lay.sl("valueCtr")] = st["valueCtr"]
-        return vec
 
 
 @lru_cache(maxsize=None)

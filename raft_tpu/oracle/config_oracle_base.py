@@ -12,6 +12,8 @@ spec's text beats further deduplication.
 
 from __future__ import annotations
 
+import itertools
+
 # enums shared by both specs' oracles (identical values; the moved
 # interpreters below resolve them from this module)
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
@@ -526,6 +528,315 @@ class ConfigOracleBase:
         )
 
     # ---------- VIEW + SYMMETRY ----------
+
+    # ---------------- shared Next enumeration (round-5 dedup) ------------
+    # Variants supply only their reconfig arms (_config_successors) and
+    # any arms between the snapshot handlers and the end of Next
+    # (_tail_successors; AddRemove's ResetWithSameIdentity — the joint
+    # spec comments it out of Next, :988).
+
+    def _config_successors(self, st) -> list:
+        raise NotImplementedError
+
+    def _tail_successors(self, st) -> list:
+        return []
+
+    def successors(self, st) -> list:
+        out = []
+        S, V = self.S, self.V
+        for i in range(S):
+            s2 = self.restart(st, i)
+            if s2 is not None:
+                out.append((f"Restart({i})", s2))
+        for m in self._domain(st):
+            s2 = self.update_term(st, m)
+            if s2 is not None:
+                out.append(("UpdateTerm", s2))
+        for i in range(S):
+            s2 = self.request_vote(st, i)
+            if s2 is not None:
+                out.append((f"RequestVote({i})", s2))
+        for i in range(S):
+            s2 = self.become_leader(st, i)
+            if s2 is not None:
+                out.append((f"BecomeLeader({i})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_request(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_request_vote_response(st, m)
+            if s2 is not None:
+                out.append(("HandleRequestVoteResponse", s2))
+        for i in range(S):
+            for v in range(V):
+                s2 = self.client_request(st, i, v)
+                if s2 is not None:
+                    out.append((f"ClientRequest({i},{v})", s2))
+        for i in range(S):
+            s2 = self.advance_commit_index(st, i)
+            if s2 is not None:
+                out.append((f"AdvanceCommitIndex({i})", s2))
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.append_entries(st, i, j)
+                    if s2 is not None:
+                        out.append((f"AppendEntries({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.reject_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("RejectAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.accept_append_entries_request(st, m)
+            if s2 is not None:
+                out.append(("AcceptAppendEntriesRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_append_entries_response(st, m)
+            if s2 is not None:
+                out.append(("HandleAppendEntriesResponse", s2))
+        out += self._config_successors(st)
+        for i in range(S):
+            for j in range(S):
+                if i != j:
+                    s2 = self.send_snapshot(st, i, j)
+                    if s2 is not None:
+                        out.append((f"SendSnapshot({i},{j})", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_request(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotRequest", s2))
+        for m in self._domain(st):
+            s2 = self.handle_snapshot_response(st, m)
+            if s2 is not None:
+                out.append(("HandleSnapshotResponse", s2))
+        out += self._tail_successors(st)
+        return out
+
+    # ------------- shared VIEW/SYMMETRY serialization (round-5) -----------
+    # Variant hooks: per-entry and per-config-row serialization and
+    # permutation, plus the spec's extra bounding counters.
+
+    counter_keys: tuple = ()
+
+    def _ser_entry(self, e) -> tuple:
+        raise NotImplementedError
+
+    def _ser_config_row(self, c) -> tuple:
+        raise NotImplementedError
+
+    def _perm_entry(self, e, sigma) -> tuple:
+        raise NotImplementedError
+
+    def _perm_config_row(self, c, sigma) -> tuple:
+        raise NotImplementedError
+
+    def _ser_log(self, log) -> tuple:
+        return tuple(tuple(self._ser_entry(e) for e in lg) for lg in log)
+
+    def serialize_view(self, st) -> tuple:
+        """The cfg VIEW: aux vars excluded (joint :144, add/remove :159)."""
+        return (
+            tuple(self._ser_config_row(c) for c in st["config"]),
+            st["currentTerm"],
+            st["state"],
+            tuple(-1 if v is None else v for v in st["votedFor"]),
+            tuple(tuple(sorted(vs)) for vs in st["votesGranted"]),
+            st["nextIndex"],
+            st["matchIndex"],
+            st["pendingResponse"],
+            self._ser_log(st["log"]),
+            st["commitIndex"],
+            self._ser_msgs(st["messages"]),
+        )
+
+    def serialize_full(self, st) -> tuple:
+        ack = {None: -1, False: 0, True: 1}
+        return (
+            self.serialize_view(st)
+            + (
+                tuple(ack[a] for a in st["acked"]),
+                st["electionCtr"],
+                st["restartCtr"],
+            )
+            + tuple(st[k] for k in self.counter_keys)
+            + (st["valueCtr"],)
+        )
+
+    def permute(self, st, sigma) -> dict:
+        """Apply a server permutation (old -> new index)."""
+        S = self.S
+        inv = [0] * S
+        for old, new in enumerate(sigma):
+            inv[new] = old
+
+        def prow(t):
+            return tuple(t[inv[k]] for k in range(S))
+
+        def pmsg(m):
+            d = dict(m)
+            d["msource"] = sigma[d["msource"]]
+            d["mdest"] = sigma[d["mdest"]]
+            if "mentries" in d:
+                d["mentries"] = tuple(
+                    self._perm_entry(e, sigma) for e in d["mentries"])
+            if "mlog" in d:
+                d["mlog"] = tuple(
+                    self._perm_entry(e, sigma) for e in d["mlog"])
+            if "mmembers" in d:
+                d["mmembers"] = frozenset(sigma[x] for x in d["mmembers"])
+            return rec(**d)
+
+        return self._with(
+            st,
+            config=tuple(
+                self._perm_config_row(c, sigma) for c in prow(st["config"])
+            ),
+            currentTerm=prow(st["currentTerm"]),
+            state=prow(st["state"]),
+            votedFor=tuple(
+                None if v is None else sigma[v] for v in prow(st["votedFor"])
+            ),
+            votesGranted=tuple(
+                frozenset(sigma[j] for j in vs) for vs in prow(st["votesGranted"])
+            ),
+            nextIndex=tuple(prow(row) for row in prow(st["nextIndex"])),
+            matchIndex=tuple(prow(row) for row in prow(st["matchIndex"])),
+            pendingResponse=tuple(prow(row) for row in prow(st["pendingResponse"])),
+            log=tuple(
+                tuple(self._perm_entry(e, sigma) for e in lg)
+                for lg in prow(st["log"])
+            ),
+            commitIndex=prow(st["commitIndex"]),
+            messages=frozenset((pmsg(m), c) for m, c in st["messages"]),
+        )
+
+    def canon(self, st, symmetry: bool = True) -> tuple:
+        if not symmetry:
+            return self.serialize_view(st)
+        return min(
+            self.serialize_view(self.permute(st, list(sigma)))
+            for sigma in itertools.permutations(range(self.S))
+        )
+
+    # -------- shared invariants (round-5 dedup; joint :1058-1140,
+    # add/remove :1009-1078 — identical up to the config-row members
+    # accessor; MaxOneReconfigurationAtATime stays variant-specific) ----
+
+    def _cfg_members_of(self, c) -> frozenset:
+        raise NotImplementedError  # members set inside a config row
+
+    def no_log_divergence(self, st) -> bool:
+        """Full-entry equality below the joint commitIndex."""
+        for s1 in range(self.S):
+            for s2 in range(self.S):
+                if s1 == s2:
+                    continue
+                ci = min(st["commitIndex"][s1], st["commitIndex"][s2])
+                for idx in range(1, ci + 1):
+                    if st["log"][s1][idx - 1] != st["log"][s2][idx - 1]:
+                        return False
+        return True
+
+    def leader_has_all_acked_values(self, st) -> bool:
+        """Only AppendCommand entries can match a client value."""
+        for v in range(self.V):
+            if st["acked"][v] is not True:
+                continue
+            for i in range(self.S):
+                if st["state"][i] != LEADER:
+                    continue
+                if any(
+                    st["currentTerm"][l] > st["currentTerm"][i]
+                    for l in range(self.S)
+                    if l != i
+                ):
+                    continue
+                if not any(
+                    e[0] == APPEND_CMD and e[2] == v for e in st["log"][i]
+                ):
+                    return False
+        return True
+
+    def committed_entries_reach_majority(self, st) -> bool:
+        """Quorum drawn from config[i].members and must contain i."""
+        leaders = [
+            i
+            for i in range(self.S)
+            if st["state"][i] == LEADER and st["commitIndex"][i] > 0
+        ]
+        if not leaders:
+            return True
+        for i in leaders:
+            members = self._cfg_members_of(st["config"][i])
+            if i not in members:
+                continue
+            ci = st["commitIndex"][i]
+            if len(st["log"][i]) < ci:
+                continue
+            entry = st["log"][i][ci - 1]
+            agree = {
+                j
+                for j in members
+                if len(st["log"][j]) >= ci and st["log"][j][ci - 1] == entry
+            }
+            if i in agree and len(agree) >= len(members) // 2 + 1:
+                return True
+        return False
+
+    # ------ shared AdvanceCommitIndex skeleton (round-5 dedup; joint
+    # :613-653 dual-quorum, add/remove :605-642 member quorum) ---------
+
+    def _commit_agree_ok(self, st, i, idx) -> bool:
+        raise NotImplementedError  # variant quorum rule at log index idx
+
+    def _committed_removal(self, log_i, idx, i) -> bool:
+        raise NotImplementedError  # did committing idx remove server i?
+
+    _mrre = None  # staticmethod(most_recent_reconfig_entry) per variant
+    _config_for = None  # staticmethod(config_for) per variant
+
+    def advance_commit_index(self, st, i):
+        if st["state"][i] != LEADER:
+            return None
+        log_i = st["log"][i]
+        best = 0
+        for idx in range(1, len(log_i) + 1):
+            if self._commit_agree_ok(st, i, idx):
+                best = idx
+        new_ci = (
+            best
+            if best > 0 and log_i[best - 1][1] == st["currentTerm"][i]
+            else st["commitIndex"][i]
+        )
+        if st["commitIndex"][i] >= new_ci:
+            return None
+        acked = list(st["acked"])
+        for idx in range(st["commitIndex"][i] + 1, new_ci + 1):
+            cmd, _t, val = log_i[idx - 1]
+            if cmd == APPEND_CMD and st["acked"][val] is False:
+                acked[val] = True
+        cfg_idx, cfg_entry = type(self)._mrre(log_i)
+        new_config = type(self)._config_for(cfg_idx, cfg_entry, new_ci)
+        removed = any(
+            self._committed_removal(log_i, idx, i)
+            for idx in range(st["commitIndex"][i] + 1, new_ci + 1)
+        )
+        upd = dict(
+            acked=tuple(acked),
+            config=self._set(st["config"], i, new_config),
+        )
+        if removed:
+            upd.update(
+                state=self._set(st["state"], i, NOTMEMBER),
+                votesGranted=self._set(st["votesGranted"], i, frozenset()),
+                nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
+                matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
+                commitIndex=self._set(st["commitIndex"], i, 0),
+            )
+        else:
+            upd["commitIndex"] = self._set(st["commitIndex"], i, new_ci)
+        return self._with(st, **upd)
 
     def _receivable(self, st, m, mtype: str, equal_term: bool) -> bool:
         """ReceivableMessage — :212-218."""

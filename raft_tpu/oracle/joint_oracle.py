@@ -197,61 +197,11 @@ class JointRaftOracle(ConfigOracleBase):
 
     # ---------- actions (Next order, :966-988) ----------
 
-    def successors(self, st) -> list[tuple[str, dict]]:
+    counter_keys = ("reconfigCtr",)
+
+    def _config_successors(self, st) -> list:
         out = []
-        S, V = self.S, self.V
-        for i in range(S):
-            s2 = self.restart(st, i)
-            if s2 is not None:
-                out.append((f"Restart({i})", s2))
-        for m in self._domain(st):
-            s2 = self.update_term(st, m)
-            if s2 is not None:
-                out.append(("UpdateTerm", s2))
-        for i in range(S):
-            s2 = self.request_vote(st, i)
-            if s2 is not None:
-                out.append((f"RequestVote({i})", s2))
-        for i in range(S):
-            s2 = self.become_leader(st, i)
-            if s2 is not None:
-                out.append((f"BecomeLeader({i})", s2))
-        for m in self._domain(st):
-            s2 = self.handle_request_vote_request(st, m)
-            if s2 is not None:
-                out.append(("HandleRequestVoteRequest", s2))
-        for m in self._domain(st):
-            s2 = self.handle_request_vote_response(st, m)
-            if s2 is not None:
-                out.append(("HandleRequestVoteResponse", s2))
-        for i in range(S):
-            for v in range(V):
-                s2 = self.client_request(st, i, v)
-                if s2 is not None:
-                    out.append((f"ClientRequest({i},{v})", s2))
-        for i in range(S):
-            s2 = self.advance_commit_index(st, i)
-            if s2 is not None:
-                out.append((f"AdvanceCommitIndex({i})", s2))
-        for i in range(S):
-            for j in range(S):
-                if i != j:
-                    s2 = self.append_entries(st, i, j)
-                    if s2 is not None:
-                        out.append((f"AppendEntries({i},{j})", s2))
-        for m in self._domain(st):
-            s2 = self.reject_append_entries_request(st, m)
-            if s2 is not None:
-                out.append(("RejectAppendEntriesRequest", s2))
-        for m in self._domain(st):
-            s2 = self.accept_append_entries_request(st, m)
-            if s2 is not None:
-                out.append(("AcceptAppendEntriesRequest", s2))
-        for m in self._domain(st):
-            s2 = self.handle_append_entries_response(st, m)
-            if s2 is not None:
-                out.append(("HandleAppendEntriesResponse", s2))
-        for i in range(S):
+        for i in range(self.S):
             for add, remove in self._reconfig_shapes():
                 s2 = self.append_old_new_config(st, i, add, remove)
                 if s2 is not None:
@@ -261,26 +211,14 @@ class JointRaftOracle(ConfigOracleBase):
                             s2,
                         )
                     )
-        for i in range(S):
+        for i in range(self.S):
             s2 = self.append_new_config(st, i)
             if s2 is not None:
                 out.append((f"AppendNewConfigToLog({i})", s2))
-        for i in range(S):
-            for j in range(S):
-                if i != j:
-                    s2 = self.send_snapshot(st, i, j)
-                    if s2 is not None:
-                        out.append((f"SendSnapshot({i},{j})", s2))
-        for m in self._domain(st):
-            s2 = self.handle_snapshot_request(st, m)
-            if s2 is not None:
-                out.append(("HandleSnapshotRequest", s2))
-        for m in self._domain(st):
-            s2 = self.handle_snapshot_response(st, m)
-            if s2 is not None:
-                out.append(("HandleSnapshotResponse", s2))
-        # ResetWithSameIdentity is commented out of Next (:988)
         return out
+
+    # (no _tail_successors: ResetWithSameIdentity is commented out of
+    # this spec's Next, :988)
 
     def _reconfig_shapes(self):
         """All (addMembers, removeMembers) subset pairs admitted by
@@ -319,64 +257,28 @@ class JointRaftOracle(ConfigOracleBase):
             pendingResponse=self._set(st["pendingResponse"], i, (False,) * self.S),
         )
 
-    def advance_commit_index(self, st, i):
-        """AdvanceCommitIndex(i) — :613-653: dual-quorum agreement while
-        joint (:626-629)."""
-        if st["state"][i] != LEADER:
-            return None
-        _id, joint, members, old, new, _committed = st["config"][i]
-        log_i = st["log"][i]
+    _mrre = staticmethod(most_recent_reconfig_entry)
+    _config_for = staticmethod(config_for)
 
-        def agree(idx, member_set):
+    def _commit_agree_ok(self, st, i, idx) -> bool:
+        """Dual-quorum agreement while joint (:626-629)."""
+        _id, joint, members, old, new, _committed = st["config"][i]
+
+        def agree(member_set):
             a = {k for k in member_set if st["matchIndex"][i][k] >= idx}
             if i in member_set:
                 a |= {i}
             return a
 
-        best = 0
-        for idx in range(1, len(log_i) + 1):
-            if joint:
-                ok = self._quorum(agree(idx, old), old) and self._quorum(
-                    agree(idx, new), new
-                )
-            else:
-                ok = self._quorum(agree(idx, members), members)
-            if ok:
-                best = idx
-        new_ci = (
-            best
-            if best > 0 and log_i[best - 1][1] == st["currentTerm"][i]
-            else st["commitIndex"][i]
-        )
-        if st["commitIndex"][i] >= new_ci:
-            return None
-        acked = list(st["acked"])
-        for idx in range(st["commitIndex"][i] + 1, new_ci + 1):
-            cmd, _t, val = log_i[idx - 1]
-            if cmd == APPEND_CMD and st["acked"][val] is False:
-                acked[val] = True
-        cfg_idx, cfg_entry = most_recent_reconfig_entry(log_i)
-        new_config = config_for(cfg_idx, cfg_entry, new_ci)
-        # IsRemovedFromCluster (:606-611): NewConfigCommand without i
-        removed = any(
-            log_i[idx - 1][0] == NEW_CMD and i not in log_i[idx - 1][2][1]
-            for idx in range(st["commitIndex"][i] + 1, new_ci + 1)
-        )
-        upd = dict(
-            acked=tuple(acked),
-            config=self._set(st["config"], i, new_config),
-        )
-        if removed:
-            upd.update(
-                state=self._set(st["state"], i, NOTMEMBER),
-                votesGranted=self._set(st["votesGranted"], i, frozenset()),
-                nextIndex=self._set(st["nextIndex"], i, (1,) * self.S),
-                matchIndex=self._set(st["matchIndex"], i, (0,) * self.S),
-                commitIndex=self._set(st["commitIndex"], i, 0),
+        if joint:
+            return self._quorum(agree(old), old) and self._quorum(
+                agree(new), new
             )
-        else:
-            upd["commitIndex"] = self._set(st["commitIndex"], i, new_ci)
-        return self._with(st, **upd)
+        return self._quorum(agree(members), members)
+
+    def _committed_removal(self, log_i, idx, i) -> bool:
+        """IsRemovedFromCluster (:606-611): NewConfigCommand without i."""
+        return log_i[idx - 1][0] == NEW_CMD and i not in log_i[idx - 1][2][1]
 
     def append_old_new_config(self, st, i, add, remove):
         """AppendOldNewConfigToLog — :827-856."""
@@ -461,138 +363,50 @@ class JointRaftOracle(ConfigOracleBase):
             ),
         )
 
-    @staticmethod
-    def _ser_log(log) -> tuple:
-        def ser_entry(e):
-            cmd, term, val = e
-            if cmd == APPEND_CMD:
-                return (cmd, term, (val,))
-            if cmd == NEW_CMD:
-                return (cmd, term, (val[0], tuple(sorted(val[1]))))
-            return (
-                cmd,
-                term,
-                (
-                    val[0],
-                    tuple(sorted(val[1])),
-                    tuple(sorted(val[2])),
-                    tuple(sorted(val[3])),
-                ),
-            )
-
-        return tuple(tuple(ser_entry(e) for e in lg) for lg in log)
-
-    def serialize_view(self, st) -> tuple:
-        """view — :144: all aux vars excluded."""
+    def _ser_entry(self, e) -> tuple:
+        cmd, term, val = e
+        if cmd == APPEND_CMD:
+            return (cmd, term, (val,))
+        if cmd == NEW_CMD:
+            return (cmd, term, (val[0], tuple(sorted(val[1]))))
         return (
-            tuple(
-                (
-                    c[0],
-                    c[1],
-                    tuple(sorted(c[2])),
-                    tuple(sorted(c[3])),
-                    tuple(sorted(c[4])),
-                    c[5],
-                )
-                for c in st["config"]
+            cmd,
+            term,
+            (
+                val[0],
+                tuple(sorted(val[1])),
+                tuple(sorted(val[2])),
+                tuple(sorted(val[3])),
             ),
-            st["currentTerm"],
-            st["state"],
-            tuple(-1 if v is None else v for v in st["votedFor"]),
-            tuple(tuple(sorted(vs)) for vs in st["votesGranted"]),
-            st["nextIndex"],
-            st["matchIndex"],
-            st["pendingResponse"],
-            self._ser_log(st["log"]),
-            st["commitIndex"],
-            self._ser_msgs(st["messages"]),
         )
 
-    def serialize_full(self, st) -> tuple:
-        ack = {None: -1, False: 0, True: 1}
-        return self.serialize_view(st) + (
-            tuple(ack[a] for a in st["acked"]),
-            st["electionCtr"],
-            st["restartCtr"],
-            st["reconfigCtr"],
-            st["valueCtr"],
+    def _ser_config_row(self, c) -> tuple:
+        return (
+            c[0], c[1], tuple(sorted(c[2])), tuple(sorted(c[3])),
+            tuple(sorted(c[4])), c[5],
         )
 
-    def permute(self, st, sigma) -> dict:
-        S = self.S
-        inv = [0] * S
-        for old, new in enumerate(sigma):
-            inv[new] = old
+    def _perm_entry(self, e, sigma) -> tuple:
+        cmd, term, val = e
+        if cmd == APPEND_CMD:
+            return e
+        ps = lambda fs: frozenset(sigma[x] for x in fs)
+        if cmd == NEW_CMD:
+            return (cmd, term, (val[0], ps(val[1])))
+        return (cmd, term, (val[0], ps(val[1]), ps(val[2]), ps(val[3])))
 
-        def prow(t):
-            return tuple(t[inv[k]] for k in range(S))
-
-        def pset(fs):
-            return frozenset(sigma[x] for x in fs)
-
-        def pentry(e):
-            cmd, term, val = e
-            if cmd == APPEND_CMD:
-                return e
-            if cmd == NEW_CMD:
-                return (cmd, term, (val[0], pset(val[1])))
-            return (cmd, term, (val[0], pset(val[1]), pset(val[2]), pset(val[3])))
-
-        def pmsg(m):
-            d = dict(m)
-            d["msource"] = sigma[d["msource"]]
-            d["mdest"] = sigma[d["mdest"]]
-            if "mentries" in d:
-                d["mentries"] = tuple(pentry(e) for e in d["mentries"])
-            if "mlog" in d:
-                d["mlog"] = tuple(pentry(e) for e in d["mlog"])
-            if "mmembers" in d:
-                d["mmembers"] = pset(d["mmembers"])
-            return rec(**d)
-
-        return self._with(
-            st,
-            config=tuple(
-                (c[0], c[1], pset(c[2]), pset(c[3]), pset(c[4]), c[5])
-                for c in prow(st["config"])
-            ),
-            currentTerm=prow(st["currentTerm"]),
-            state=prow(st["state"]),
-            votedFor=tuple(
-                None if v is None else sigma[v] for v in prow(st["votedFor"])
-            ),
-            votesGranted=tuple(
-                frozenset(sigma[j] for j in vs) for vs in prow(st["votesGranted"])
-            ),
-            nextIndex=tuple(prow(row) for row in prow(st["nextIndex"])),
-            matchIndex=tuple(prow(row) for row in prow(st["matchIndex"])),
-            pendingResponse=tuple(prow(row) for row in prow(st["pendingResponse"])),
-            log=tuple(tuple(pentry(e) for e in lg) for lg in prow(st["log"])),
-            commitIndex=prow(st["commitIndex"]),
-            messages=frozenset((pmsg(m), c) for m, c in st["messages"]),
-        )
-
-    def canon(self, st, symmetry: bool = True) -> tuple:
-        if not symmetry:
-            return self.serialize_view(st)
-        return min(
-            self.serialize_view(self.permute(st, list(sigma)))
-            for sigma in itertools.permutations(range(self.S))
-        )
+    def _perm_config_row(self, c, sigma) -> tuple:
+        ps = lambda fs: frozenset(sigma[x] for x in fs)
+        return (c[0], c[1], ps(c[2]), ps(c[3]), ps(c[4]), c[5])
 
     # ---------- invariants (:1058-1140) ----------
 
-    def no_log_divergence(self, st) -> bool:
-        """NoLogDivergence — :1066-1074."""
-        for s1 in range(self.S):
-            for s2 in range(self.S):
-                if s1 == s2:
-                    continue
-                ci = min(st["commitIndex"][s1], st["commitIndex"][s2])
-                for idx in range(1, ci + 1):
-                    if st["log"][s1][idx - 1] != st["log"][s2][idx - 1]:
-                        return False
-        return True
+    def _cfg_members_of(self, c) -> frozenset:
+        return c[2]
+
+    # no_log_divergence / leader_has_all_acked_values /
+    # committed_entries_reach_majority: shared in ConfigOracleBase
+    # (spec formulas :1066-1074/:1109-1125/:1129-1140)
 
     def max_one_reconfiguration_at_a_time(self, st) -> bool:
         """MaxOneReconfigurationAtATime — :1080-1101: two same-type config
@@ -617,57 +431,12 @@ class JointRaftOracle(ConfigOracleBase):
                             return False
         return True
 
-    def leader_has_all_acked_values(self, st) -> bool:
-        """LeaderHasAllAckedValues — :1109-1125."""
-        for v in range(self.V):
-            if st["acked"][v] is not True:
-                continue
-            for i in range(self.S):
-                if st["state"][i] != LEADER:
-                    continue
-                if any(
-                    st["currentTerm"][l] > st["currentTerm"][i]
-                    for l in range(self.S)
-                    if l != i
-                ):
-                    continue
-                if not any(
-                    e[0] == APPEND_CMD and e[2] == v for e in st["log"][i]
-                ):
-                    return False
-        return True
-
-    def committed_entries_reach_majority(self, st) -> bool:
-        """CommittedEntriesReachMajority — :1129-1140."""
-        leaders = [
-            i
-            for i in range(self.S)
-            if st["state"][i] == LEADER and st["commitIndex"][i] > 0
-        ]
-        if not leaders:
-            return True
-        for i in leaders:
-            members = st["config"][i][2]
-            if i not in members:
-                continue
-            ci = st["commitIndex"][i]
-            if len(st["log"][i]) < ci:
-                continue
-            entry = st["log"][i][ci - 1]
-            agree = {
-                j
-                for j in members
-                if len(st["log"][j]) >= ci and st["log"][j][ci - 1] == entry
-            }
-            if i in agree and len(agree) >= len(members) // 2 + 1:
-                return True
-        return False
-
     INVARIANTS = {
-        "NoLogDivergence": no_log_divergence,
+        "NoLogDivergence": ConfigOracleBase.no_log_divergence,
         "MaxOneReconfigurationAtATime": max_one_reconfiguration_at_a_time,
-        "LeaderHasAllAckedValues": leader_has_all_acked_values,
-        "CommittedEntriesReachMajority": committed_entries_reach_majority,
+        "LeaderHasAllAckedValues": ConfigOracleBase.leader_has_all_acked_values,
+        "CommittedEntriesReachMajority":
+            ConfigOracleBase.committed_entries_reach_majority,
         "TestInv": lambda self, st: True,
     }
 

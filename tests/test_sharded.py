@@ -43,6 +43,34 @@ def test_sharded_counts_match_oracle(ndev):
 
 
 @pytest.mark.slow
+def test_sharded_3server_nontoy_parity():
+    """Non-toy sharded regression (round-4 verdict Weak #5): the 3-server
+    MaxElections=1 space (~22k distinct, waves far wider than chunk) on a
+    D=4 mesh must exhaust with counts identical to the single-device
+    engine — route_cap/growth at real widths, not the 2-server toy."""
+    from raft_tpu.checker.bfs import BFSChecker
+
+    p3 = RaftParams(n_servers=3, n_values=1, max_elections=1,
+                    max_restarts=0, msg_slots=24)
+    model = cached_model(p3)
+    engine = ShardedBFS(
+        model,
+        invariants=("LeaderHasAllAckedValues", "NoLogDivergence"),
+        symmetry=True,
+        devices=jax.devices()[:4],
+        chunk=512,
+        frontier_cap=4096,
+        seen_cap=1 << 14,
+    )
+    res = engine.run()
+    ref = BFSChecker(model, invariants=(), symmetry=True, chunk=1024).run()
+    assert res.violation_invariant is None
+    assert res.exhausted and ref.exhausted
+    assert res.distinct == ref.distinct
+    assert res.depth_counts == ref.depth_counts
+
+
+@pytest.mark.slow
 def test_sharded_substep_and_growth_parity():
     """Tiny chunk + tiny initial caps force the sub-stepping cursor (wave
     frontier > chunk) AND between-wave buffer growth; counts must still be
