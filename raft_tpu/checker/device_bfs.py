@@ -49,7 +49,7 @@ from jax import lax
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
-from .lsm import RunLSM, pow2_at_least
+from .lsm import pow2_at_least
 from .util import GROWTH, HEADROOM, I32_MAX, next_cap, probe_sorted as _probe
 
 
@@ -110,43 +110,85 @@ class DeviceBFS:
         # unclamped cursor, skipping tail states); requiring divisibility
         # keeps every slice in bounds
         assert frontier_cap % chunk == 0, "frontier_cap must be a multiple of chunk"
-        # LSM geometry: run level i holds R0 << i lanes, capped at TOPSZ
-        # (shared implementation: checker/lsm.py)
+        # seen-set geometry (round 5): ONE device-resident sorted run,
+        # sized from a small pow2 ladder and merged with the wave's
+        # fingerprint ladder ON DEVICE once per wave. Every extra
+        # multi-million-lane run cost ~20-50 ms of searchsorted per
+        # CHUNK under the old binary-counter LSM (deep waves probing 3
+        # runs measured 352 ms/chunk vs 214 for 1-run neighbours), and
+        # host-side repacks moved tens of MB through the ~25 MB/s
+        # tunnel; the single-run design probes once and never leaves
+        # HBM. The few (size -> size) merge signatures precompile.
         self.R0 = pow2_at_least(self.VC)
         self.SCAP = self.MAX_SCAP  # capacity bound (kept for callers)
-        self._lsm = RunLSM(r0=self.R0, topsz=pow2_at_least(self.MAX_SCAP))
-        self.TOPSZ = self._lsm.TOPSZ
+        self.TOPSZ = pow2_at_least(self.MAX_SCAP)
+        sizes = []
+        s = min(max(self.R0, 1 << 18), self.TOPSZ)
+        while s < self.TOPSZ:
+            sizes.append(s)
+            s <<= 2
+        sizes.append(self.TOPSZ)
+        self._seen_sizes = sizes
+        self._seen = None  # device u64 [size], sorted, U64_MAX-padded
+        self._seen_real = 0
+        self._merge_cache: dict = {}
         self.canon = Canonicalizer.for_model(
             model, symmetry=symmetry, seed=fingerprint_seed
         )
-        # donated: next_buf, jparent, jcand, viol, stats (runs are read-only)
+        # donated: next_buf, jparent, jcand, viol, stats (seen read-only)
         self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=(1, 2, 3, 4, 5))
-        self._occ_cache: dict[bytes, object] = {}
+        self._wave_fn = jax.jit(self._wave_step, donate_argnums=(1, 2, 3, 4, 5))
         self._flag_true = jnp.asarray(True)
         self._flag_false = jnp.asarray(False)
+        self._occ_one = jnp.ones((1,), bool)
         self._init_distinct: np.ndarray | None = None
         self._jparent = None
         self._jcand = None
         self._jcount = 0
 
-    # ---------------- LSM seen-set adapters ----------------
-
-    def _occ_dev(self):
-        """Occupancy flags as a device array, uploaded once per distinct
-        pattern (a fresh upload per chunk is a whole tunnel dispatch)."""
-        key = bytes(self._lsm.occ)
-        arr = self._occ_cache.get(key)
-        if arr is None:
-            arr = jnp.asarray(np.asarray(self._lsm.occ, dtype=bool))
-            self._occ_cache[key] = arr
-        return arr
+    # ---------------- seen-set adapters ----------------
 
     def _flag(self, v: bool):
         return self._flag_true if v else self._flag_false
 
+    def _seen_size_for(self, n: int) -> int:
+        for s in self._seen_sizes:
+            if n <= s:
+                return s
+        raise OverflowError(
+            f"seen-set of {n} exceeds the {self.TOPSZ}-lane capacity; "
+            "raise max_seen_cap"
+        )
+
+    def _seed_seen(self, sorted_fps: np.ndarray) -> None:
+        """Upload a sorted host fingerprint array as the seen run,
+        host-padded to the ladder size (device pads would compile)."""
+        n = len(sorted_fps)
+        size = self._seen_size_for(n)
+        host = np.full((size,), np.uint64(U64_MAX))
+        host[:n] = sorted_fps
+        self._seen = jnp.asarray(host)
+        self._seen_real = n
+
+    def _merge_seen(self, ladder, new_real: int) -> None:
+        """seen <- sort(concat(seen, *ladder))[:target] on device. The
+        truncation only drops U64_MAX padding: new_real <= target by
+        construction of the size ladder."""
+        target = self._seen_size_for(new_real)
+        key = (self._seen.shape[0], tuple(l.shape[0] for l in ladder), target)
+        fn = self._merge_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda s, *lv: sort_u64(jnp.concatenate([s, *lv]))[:target]
+            )
+            self._merge_cache[key] = fn
+        self._seen = fn(self._seen, *ladder)
+        self._seen_real = new_real
+
     def _lsm_export(self) -> np.ndarray:
         """All real fingerprints, sorted (host array; checkpoint format)."""
-        return self._lsm.export_real()
+        arr = np.asarray(jax.device_get(self._seen))
+        return arr[arr != np.uint64(U64_MAX)]
 
     # ---------------- device programs ----------------
 
@@ -267,6 +309,95 @@ class DeviceBFS:
         )
         return next_buf, jparent, jcand, viol, stats, new_run
 
+    def _wave_geom(self) -> int:
+        """Ladder depth K: levels R0<<0 .. R0<<K, top >= pow2(FCAP), so a
+        whole wave's new fingerprints fit in-program (the top absorbs by
+        truncate-merge, sound while the wave's real new count <= FCAP —
+        the frontier overflow bit aborts the run otherwise)."""
+        K = 0
+        while (self.R0 << K) < pow2_at_least(self.FCAP):
+            K += 1
+        return K
+
+    def _wave_step(
+        self, frontier, next_buf, jparent, jcand, viol, stats,
+        fcount, base_gid, occ, *runs,
+    ):
+        """One WAVE as a single dispatched program (round 5, verdict Next
+        #1): a lax.while_loop drives the chunk pipeline over the frontier,
+        deduplicating in-wave against an in-program binary-counter ladder
+        of sorted fingerprint runs — so the host dispatches ONCE per wave
+        and syncs once, instead of paying the tunnel's per-dispatch
+        service cost (~100-150 ms after compile activity) per chunk; a
+        170-chunk deep wave collapses from ~170 service slots to 1.
+        Returns (next_buf, jparent, jcand, viol, stats, *ladder); the
+        host inserts the occupied ladder levels into the RunLSM."""
+        C = self.chunk
+        K = self._wave_geom()
+        R0 = self.R0
+
+        stats = stats * jnp.asarray([0, 1, 1, 1, 0], dtype=stats.dtype)
+        occ_all = jnp.concatenate(
+            [occ, jnp.ones((K + 1,), bool)]
+        )  # ladder levels always probed (empties hold U64_MAX padding)
+        ladder0 = tuple(
+            jnp.full((R0 << i,), U64_MAX, jnp.uint64) for i in range(K + 1)
+        )
+        topsz = R0 << K
+
+        def cascade(k, new_run, ladder):
+            """Binary-counter insert of the chunk's R0-run: after chunk k,
+            the ladder encodes counter k+1. The merge chain length is the
+            number of trailing one-bits of k (capped at K, where the top
+            absorbs by truncate-merge)."""
+            kp1 = k + 1
+            t = jnp.int32(0)
+            for i in range(1, K + 1):
+                t = t + (kp1 & ((1 << i) - 1) == 0).astype(jnp.int32)
+
+            def make_branch(tt):
+                def branch(r, *lv):
+                    out = list(lv)
+                    if tt < K:
+                        merged = sort_u64(
+                            jnp.concatenate([r, *lv[:tt]])
+                        )  # R0 * 2^tt lanes
+                        for i in range(tt):
+                            out[i] = jnp.full((R0 << i,), U64_MAX, jnp.uint64)
+                        out[tt] = merged
+                    else:
+                        merged = sort_u64(jnp.concatenate([r, *lv]))[:topsz]
+                        for i in range(K):
+                            out[i] = jnp.full((R0 << i,), U64_MAX, jnp.uint64)
+                        out[K] = merged
+                    return tuple(out)
+
+                return branch
+
+            return lax.switch(
+                jnp.clip(t, 0, K), [make_branch(tt) for tt in range(K + 1)],
+                new_run, *ladder,
+            )
+
+        def body(carry):
+            k, next_buf, jparent, jcand, viol, stats, *ladder = carry
+            next_buf, jparent, jcand, viol, stats, new_run = self._chunk_step(
+                frontier, next_buf, jparent, jcand, viol, stats,
+                k * C, fcount, base_gid, occ_all, jnp.asarray(False),
+                *runs, *ladder,
+            )
+            ladder = cascade(k, new_run, ladder)
+            return (k + 1, next_buf, jparent, jcand, viol, stats, *ladder)
+
+        def cond(carry):
+            return carry[0] * C < fcount
+
+        out = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), next_buf, jparent, jcand, viol, stats, *ladder0),
+        )
+        return out[1:]
+
     # ---------------- precompile ----------------
 
     def precompile(self) -> None:
@@ -281,18 +412,36 @@ class DeviceBFS:
         still retrace, so benchmark callers should start at their final
         capacities."""
         W = self.W
+        K = self._wave_geom()
         frontier = jnp.zeros((self.FCAP + 1, W), jnp.int32)
-        next_buf = jnp.zeros((self.FCAP + 1, W), jnp.int32)
-        jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
-        jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
-        viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
-        stats = jnp.zeros((5,), jnp.int64)
-        self._chunk_fn(
-            frontier, next_buf, jparent, jcand, viol, stats,
-            np.int32(0), np.int32(0), np.int32(0),
-            self._occ_dev(), self._flag(True), *self._lsm.runs,
+        ladder = tuple(
+            jnp.full((self.R0 << i,), U64_MAX, jnp.uint64) for i in range(K + 1)
         )
-        self._lsm.warmup()
+        for si, size in enumerate(self._seen_sizes):
+            seen = jnp.full((size,), U64_MAX, jnp.uint64)
+            next_buf = jnp.zeros((self.FCAP + 1, W), jnp.int32)
+            jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
+            jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
+            viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
+            stats = jnp.zeros((5,), jnp.int64)
+            self._wave_fn(
+                frontier, next_buf, jparent, jcand, viol, stats,
+                np.int32(0), np.int32(0), self._occ_one, seen,
+            )
+            # per-wave seen merges this size can need (targets >= size;
+            # one wave adds at most pow2(FCAP) real lanes, so targets
+            # further than two ladder steps up are unreachable)
+            lshapes = tuple(l.shape[0] for l in ladder)
+            for target in self._seen_sizes[si:]:
+                key = (size, lshapes, target)
+                if key in self._merge_cache:
+                    continue
+                fn = jax.jit(
+                    lambda s, *lv, _t=target: sort_u64(
+                        jnp.concatenate([s, *lv]))[:_t]
+                )
+                fn(seen, *ladder)
+                self._merge_cache[key] = fn
 
     # ---------------- capacity growth ----------------
 
@@ -376,7 +525,7 @@ class DeviceBFS:
                 self.JCAP, self.MAX_JCAP, self.GROWTH, 1)
             seed_rows = (np.asarray(ck["frontier"]), np.asarray(ck["jparent"]),
                          np.asarray(ck["jcand"]))
-            self._lsm.seed(np.asarray(ck["seen"], dtype=np.uint64))
+            self._seed_seen(np.asarray(ck["seen"], dtype=np.uint64))
             violation = None
             distinct = int(ck["distinct"])
             total = int(ck["total"])
@@ -388,7 +537,7 @@ class DeviceBFS:
             stats0 = np.array([0, jcount, gen_prev, terminal, 0], dtype=np.int64)
         else:
             violation = self._check_init(init_d)
-            self._lsm.seed(np.sort(init_fps[keep]))
+            self._seed_seen(np.sort(init_fps[keep]))
             seed_rows = (init_d, np.zeros((0,), np.int32),
                          np.zeros((0,), np.int32))
             fcount = n0
@@ -468,22 +617,18 @@ class DeviceBFS:
                 )
                 last_ckpt = time.perf_counter()
             tw = time.perf_counter()
-            # wave-start LSM snapshot: run arrays are immutable device
-            # buffers, so two list copies make the overflow path below
-            # resumable (round-4 advisor: a mid-wave capacity overflow
-            # used to raise after the LSM had absorbed part of the wave,
-            # losing everything since the last periodic save)
-            wave_lsm = (list(self._lsm.runs), list(self._lsm.occ))
-            chunks_done = 0
-            for cursor in range(0, fcount, C):
-                next_buf, jparent, jcand, viol, stats, new_run = self._chunk_fn(
-                    frontier, next_buf, jparent, jcand, viol, stats,
-                    np.int32(cursor), np.int32(fcount), np.int32(base_gid),
-                    self._occ_dev(), self._flag(chunks_done == 0),
-                    *self._lsm.runs,
-                )
-                self._lsm.insert(new_run)
-                chunks_done += 1
+            # ONE dispatch per wave: the chunk loop runs device-side
+            # (_wave_step) and returns the wave's new fingerprints as a
+            # binary-counter ladder, merged into the single seen run
+            # below AFTER the overflow check (so an aborted wave leaves
+            # the seen-set untouched and the run trivially resumable)
+            out = self._wave_fn(
+                frontier, next_buf, jparent, jcand, viol, stats,
+                np.int32(fcount), np.int32(base_gid),
+                self._occ_one, self._seen,
+            )
+            next_buf, jparent, jcand, viol, stats = out[:5]
+            ladder = out[5:]
             # one host round-trip per wave: stats and the invariant fold
             # fetched together (two device_gets double the tunnel RTT on
             # small configs, where per-wave latency dominates)
@@ -495,12 +640,12 @@ class DeviceBFS:
             if ovf_bits:
                 saved = ""
                 if checkpoint_path is not None:
-                    # roll the LSM back to its wave-start snapshot; the
+                    # the aborted wave never touched the seen run (its
+                    # fingerprints live in the discarded ladder), and the
                     # frontier buffer and journal[:jcount] are untouched
-                    # by the aborted wave (only next_buf and journal rows
-                    # past jcount were written), so the wave-start state
-                    # is exactly reconstructible and resumable
-                    self._lsm.runs, self._lsm.occ = wave_lsm
+                    # (only next_buf and journal rows past jcount were
+                    # written), so the wave-start state is exactly
+                    # reconstructible and resumable (round-4 advisor #1)
                     self._save_checkpoint(
                         checkpoint_path, frontier, jparent, jcand, fcount,
                         scount, distinct, total, terminal, depth, base_gid,
@@ -520,6 +665,10 @@ class DeviceBFS:
             if ncount == 0:
                 break
             scount += ncount
+            # fold the wave ladder into the single seen run (device-side
+            # sort-concat; the merge-program signature set is warmed by
+            # precompile)
+            self._merge_seen(ladder, scount)
             depth += 1
             distinct += ncount
             depth_counts.append(ncount)
@@ -539,17 +688,6 @@ class DeviceBFS:
             frontier, next_buf, jparent, jcand = self._maybe_grow(
                 ncount, frontier, next_buf, jparent, jcand, scount - n0
             )
-            # Bound LSM padding waste: when the occupied lanes exceed 4x
-            # the real count, repack (rare). NOTE: consolidation compiles
-            # a program per (occupied-shapes, target) signature at ~20 s
-            # each on the tunnel's remote-compile service, so it must
-            # stay RARE — a prior mid-wave every-16-chunks repack spent
-            # more wall-clock compiling consolidators than checking
-            # states on deep runs. In-wave runs are cheap to carry: the
-            # binary cascade keeps at most ~log2(chunks) of them and
-            # empty-level probes are cond-skipped.
-            if self._lsm.lanes() > max(4 * scount, 1 << 21):
-                self._lsm.consolidate(scount)
             if (
                 checkpoint_path is not None
                 and violation is None  # a saved file must not mask a violation
@@ -571,8 +709,8 @@ class DeviceBFS:
                     "dedup_hit_rate": round(1.0 - ncount / max(1, wave_gen), 4),
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "distinct_per_s": round(distinct / el, 1),
-                    "lsm_runs": sum(self._lsm.occ),
-                    "lsm_lanes": self._lsm.lanes(),
+                    "lsm_runs": 1,
+                    "lsm_lanes": int(self._seen.shape[0]),
                 }
                 if metrics is not None:
                     metrics.append(wm)

@@ -137,7 +137,15 @@ class RunLSM:
     def insert(self, run) -> None:
         """Binary-counter insert of a sorted run (async device ops only —
         the cascade is occupancy-driven, no host sync on run contents)."""
-        lv = 0
+        self.insert_at(run, 0)
+
+    def insert_at(self, run, level: int) -> None:
+        """Insert a sorted run whose lane count equals ``lv_size(level)``
+        starting the cascade at that level (the wave-fused engine emits
+        one pre-merged ladder per wave rather than per-chunk runs)."""
+        assert run.shape[-1] == self.lv_size(level), (
+            run.shape, self.lv_size(level))
+        lv = level
         carry = run
         while True:
             if lv == len(self.runs):

@@ -16,6 +16,8 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+import jax.numpy as jnp
+
 import numpy as np
 
 # Field kinds and their transformation under a server permutation sigma
@@ -99,6 +101,37 @@ class Layout:
 
     def zeros(self, batch: tuple[int, ...] = ()) -> np.ndarray:
         return np.zeros(batch + (self.W,), dtype=np.int32)
+
+
+def onehot_row(arr, i):
+    """``arr[i]`` along axis 0 via a one-hot select.
+
+    Per-instance dynamic row gathers under vmap serialize badly on the
+    axon TPU backend when the indices are scattered (measured: the
+    expansion kernel ran 118 ms/chunk on real frontiers vs 35 ms on
+    zeros, round 5); the first axis here is the tiny server axis, so an
+    S-term select is effectively free and data-independent."""
+    S = arr.shape[0]
+    oh = jnp.arange(S, dtype=jnp.int32) == i
+    ohx = oh.reshape((S,) + (1,) * (arr.ndim - 1))
+    return jnp.sum(jnp.where(ohx, arr, 0), axis=0)
+
+
+def onehot_set(arr, i, val):
+    """``arr.at[i].set(val)`` along axis 0 via a one-hot select (see
+    onehot_row: dynamic-index row scatters serialize the same way)."""
+    S = arr.shape[0]
+    oh = jnp.arange(S, dtype=jnp.int32) == i
+    ohx = oh.reshape((S,) + (1,) * (arr.ndim - 1))
+    return jnp.where(ohx, val, arr)
+
+
+def onehot_set2(arr, i, j, val):
+    """``arr.at[i, j].set(val)`` on an [S, S] matrix via one-hot."""
+    S = arr.shape[0]
+    ohi = (jnp.arange(S, dtype=jnp.int32) == i)[:, None]
+    ohj = (jnp.arange(S, dtype=jnp.int32) == j)[None, :]
+    return jnp.where(ohi & ohj, val, arr)
 
 
 def messages_are_valid_kernel(layout: Layout, packer):

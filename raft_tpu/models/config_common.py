@@ -381,17 +381,16 @@ class ConfigRaftCommon:
         eq_term = mterm == cur
         cnt_disc = bag.bag_discard_at(cnt, m)
 
-        def reply(resp_key):
-            return self._bag_put(words, cnt_disc, resp_key)
+        # Reply: the eight handler branches are pairwise DISJOINT
+        # (mtype/term/state/result-code guards), so the incoming Discard
+        # and the response Send collapse into ONE bag_put on the branch-
+        # selected response at the end, and the successor assembles ONCE
+        # per field (round 5: eight full _asm materializations + eight
+        # full-state select chains previously dominated the kernel and
+        # blew up the XLA:CPU LLVM compile on the joint spec).
 
         # --- UpdateTerm (count may be 0)
         b_upd = occupied & (mterm > cur)
-        s_upd = self._asm(
-            d,
-            currentTerm=d["currentTerm"].at[dst].set(mterm),
-            state=d["state"].at[dst].set(FOLLOWER),
-            votedFor=d["votedFor"].at[dst].set(NIL),
-        )
 
         # --- HandleRequestVoteRequest
         last_t = self._last_term(d, dst)
@@ -412,14 +411,6 @@ class ConfigRaftCommon:
             msource=dst,
             mdest=src,
         )
-        w1, c1, _ex1, ovf1 = reply(rv_key)
-        s_rvreq = self._asm(
-            d,
-            votedFor=jnp.where(
-                grant, d["votedFor"].at[dst].set(src + 1), d["votedFor"]
-            ),
-            **self._word_upd(w1, c1),
-        )
 
         # --- HandleRequestVoteResponse
         b_rvresp = recv & (mtype == RVRESP) & eq_term & (st_dst == CANDIDATE)
@@ -430,7 +421,6 @@ class ConfigRaftCommon:
             ),
             d["votesGranted"],
         )
-        s_rvresp = self._asm(d, votesGranted=vg, msg_cnt=cnt_disc)
 
         # --- AppendEntries request handling: LogOk (strict empty-entries
         # arm, AddRemove :650-667 == JointConsensus) + result-code CASE
@@ -466,8 +456,6 @@ class ConfigRaftCommon:
             msource=dst,
             mdest=src,
         )
-        w2, c2, _ex2, ovf2 = reply(rj_key)
-        s_reject = self._asm(d, **self._word_upd(w2, c2))
 
         # AcceptAppendEntriesRequest
         b_accept = (
@@ -508,17 +496,6 @@ class ConfigRaftCommon:
             msource=dst,
             mdest=src,
         )
-        w3, c3, _ex3, ovf3 = reply(ac_key)
-        upd3 = dict(
-            commitIndex=d["commitIndex"].at[dst].set(mci),
-            state=d["state"].at[dst].set(jnp.where(in_new, FOLLOWER, NOTMEMBER)),
-            log_len=d["log_len"].at[dst].set(new_ll),
-            **cfg_upd,
-            **self._word_upd(w3, c3),
-        )
-        for n in self.ENTRY_FIELDS:
-            upd3[f"log_{n}"] = d[f"log_{n}"].at[dst].set(new_logs[n])
-        s_accept = self._asm(d, **upd3)
 
         # --- HandleAppendEntriesResponse
         b_aeresp = recv & (mtype == AERESP) & eq_term & (st_dst == LEADER)
@@ -533,18 +510,6 @@ class ConfigRaftCommon:
                 jnp.maximum(ni_cur - 1, 1),
                 jnp.where(res == RC_NEEDSNAP, PENDING_SNAP_REQUEST, ni_cur),
             ),
-        )
-        mi_new = jnp.where(
-            res == RC_OK, d["matchIndex"].at[dst, src].set(mmatch), d["matchIndex"]
-        )
-        s_aeresp = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[dst, src].set(ni_new),
-            matchIndex=mi_new,
-            pendingResponse=d["pendingResponse"].at[dst].set(
-                d["pendingResponse"][dst] & ~(jnp.int32(1) << src)
-            ),
-            msg_cnt=cnt_disc,
         )
 
         # --- HandleSnapshotRequest
@@ -569,16 +534,6 @@ class ConfigRaftCommon:
             msource=dst,
             mdest=src,
         )
-        w4, c4, _ex4, ovf4 = reply(sq_key)
-        upd4 = dict(
-            commitIndex=d["commitIndex"].at[dst].set(sn_mci),
-            log_len=d["log_len"].at[dst].set(sn_ll),
-            **sn_cfg_upd,
-            **self._word_upd(w4, c4),
-        )
-        for n in self.ENTRY_FIELDS:
-            upd4[f"log_{n}"] = d[f"log_{n}"].at[dst].set(sn_logs[n])
-        s_snapreq = self._asm(d, **upd4)
 
         # --- HandleSnapshotResponse
         b_snapresp = (
@@ -587,30 +542,91 @@ class ConfigRaftCommon:
             & eq_term
             & (d["nextIndex"][dst, src] == PENDING_SNAP_RESPONSE)
         )
-        s_snapresp = self._asm(
-            d,
-            nextIndex=d["nextIndex"].at[dst, src].set(u("mmatchIndex") + 1),
-            matchIndex=d["matchIndex"].at[dst, src].set(u("mmatchIndex")),
-            msg_cnt=cnt_disc,
+
+        # --- shared Reply: put the branch-selected response once ---
+        resp_key = [
+            jnp.where(
+                b_rvreq, kr,
+                jnp.where(b_reject, kj, jnp.where(b_accept, ka, kq)),
+            )
+            for kr, kj, ka, kq in zip(rv_key, rj_key, ac_key, sq_key)
+        ]
+        pw, pc, _ex, povf = self._bag_put(words, cnt_disc, resp_key)
+        putb = b_rvreq | b_reject | b_accept | b_snapreq
+        dropb = b_rvresp | b_aeresp | b_snapresp  # Discard only
+
+        # --- per-field combination (disjoint branches => order-free) ---
+        upd = dict(
+            currentTerm=jnp.where(
+                b_upd, d["currentTerm"].at[dst].set(mterm), d["currentTerm"]),
+            state=jnp.where(
+                b_upd, d["state"].at[dst].set(FOLLOWER),
+                jnp.where(
+                    b_accept,
+                    d["state"].at[dst].set(
+                        jnp.where(in_new, FOLLOWER, NOTMEMBER)),
+                    d["state"])),
+            votedFor=jnp.where(
+                b_upd, d["votedFor"].at[dst].set(NIL),
+                jnp.where(b_rvreq & grant,
+                          d["votedFor"].at[dst].set(src + 1), d["votedFor"])),
+            votesGranted=jnp.where(b_rvresp, vg, d["votesGranted"]),
+            commitIndex=jnp.where(
+                b_accept, d["commitIndex"].at[dst].set(mci),
+                jnp.where(b_snapreq, d["commitIndex"].at[dst].set(sn_mci),
+                          d["commitIndex"])),
+            log_len=jnp.where(
+                b_accept, d["log_len"].at[dst].set(new_ll),
+                jnp.where(b_snapreq, d["log_len"].at[dst].set(sn_ll),
+                          d["log_len"])),
+            nextIndex=jnp.where(
+                b_aeresp, d["nextIndex"].at[dst, src].set(ni_new),
+                jnp.where(
+                    b_snapresp,
+                    d["nextIndex"].at[dst, src].set(u("mmatchIndex") + 1),
+                    d["nextIndex"])),
+            matchIndex=jnp.where(
+                b_aeresp & (res == RC_OK),
+                d["matchIndex"].at[dst, src].set(mmatch),
+                jnp.where(
+                    b_snapresp,
+                    d["matchIndex"].at[dst, src].set(u("mmatchIndex")),
+                    d["matchIndex"])),
+            pendingResponse=jnp.where(
+                b_aeresp,
+                d["pendingResponse"].at[dst].set(
+                    d["pendingResponse"][dst] & ~(jnp.int32(1) << src)),
+                d["pendingResponse"]),
+            msg_cnt=jnp.where(putb, pc, jnp.where(dropb, cnt_disc, cnt)),
         )
+        for k, w in enumerate(pw):
+            upd[f"msg_w{k}"] = jnp.where(putb, w, words[k])
+        for n in self.ENTRY_FIELDS:
+            upd[f"log_{n}"] = jnp.where(
+                b_accept, d[f"log_{n}"].at[dst].set(new_logs[n]),
+                jnp.where(b_snapreq, d[f"log_{n}"].at[dst].set(sn_logs[n]),
+                          d[f"log_{n}"]))
+        for k in cfg_upd:
+            upd[k] = jnp.where(
+                b_accept, cfg_upd[k],
+                jnp.where(b_snapreq, sn_cfg_upd[k], d[k]))
+        succ = self._asm(d, **upd)
 
         branches = [
-            (b_upd, s_upd, R_UPDATETERM, jnp.asarray(False)),
-            (b_rvreq, s_rvreq, R_HANDLE_RVREQ, ovf1),
-            (b_rvresp, s_rvresp, R_HANDLE_RVRESP, jnp.asarray(False)),
-            (b_reject, s_reject, R_REJECT_AE, ovf2),
-            (b_accept, s_accept, R_ACCEPT_AE, ovf3 | ac_ovf),
-            (b_aeresp, s_aeresp, R_HANDLE_AERESP, jnp.asarray(False)),
-            (b_snapreq, s_snapreq, R_HANDLE_SNAPREQ, ovf4),
-            (b_snapresp, s_snapresp, R_HANDLE_SNAPRESP, jnp.asarray(False)),
+            (b_upd, R_UPDATETERM, jnp.asarray(False)),
+            (b_rvreq, R_HANDLE_RVREQ, povf),
+            (b_rvresp, R_HANDLE_RVRESP, jnp.asarray(False)),
+            (b_reject, R_REJECT_AE, povf),
+            (b_accept, R_ACCEPT_AE, povf | ac_ovf),
+            (b_aeresp, R_HANDLE_AERESP, jnp.asarray(False)),
+            (b_snapreq, R_HANDLE_SNAPREQ, povf),
+            (b_snapresp, R_HANDLE_SNAPRESP, jnp.asarray(False)),
         ]
         valid = jnp.asarray(False)
-        succ = s
         rank = jnp.int32(-1)
         ovf = jnp.asarray(False)
-        for b, sb, rk, ob in branches:
+        for b, rk, ob in branches:
             valid = valid | b
-            succ = jnp.where(b, sb, succ)
             rank = jnp.where(b, jnp.int32(rk), rank)
             ovf = ovf | (b & ob)
         return valid, succ, rank, ovf

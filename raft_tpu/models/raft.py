@@ -29,7 +29,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout, messages_are_valid_kernel
+from .base import Layout, messages_are_valid_kernel, onehot_row, onehot_set, onehot_set2
 
 # state[i] encoding (CONSTANTS Follower/Candidate/Leader, Raft.tla:38)
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -280,10 +280,11 @@ class RaftModel:
 
     @staticmethod
     def _last_term(d, i):
-        """LastTerm(log[i]) — Raft.tla:126."""
-        ll = d["log_len"][i]
-        lt = d["log_term"][i]
-        return jnp.where(ll > 0, lt[jnp.clip(ll - 1, 0)], 0)
+        """LastTerm(log[i]) — Raft.tla:126 (one-hot row selects: dynamic
+        row gathers serialize on scattered indices, models/base.py)."""
+        ll = onehot_row(d["log_len"], i)
+        lt = onehot_row(d["log_term"], i)
+        return jnp.where(ll > 0, onehot_row(lt, jnp.clip(ll - 1, 0)), 0)
 
     # ---------------- action kernels ----------------
     # Each returns (valid, succ_vec, rank, overflow).
@@ -559,8 +560,8 @@ class RaftModel:
         u = partial(packer.unpack, khi, klo)
         mtype, mterm = u("mtype"), u("mterm")
         src, dst = u("msource"), u("mdest")
-        ct_dst = d["currentTerm"][dst]
-        st_dst = d["state"][dst]
+        ct_dst = onehot_row(d["currentTerm"], dst)
+        st_dst = onehot_row(d["state"], dst)
         recv = occupied & (kcnt > 0)  # ReceivableMessage (Raft.tla:181-187)
 
         # Reply(response, request) — Raft.tla:170-176. The six handler
@@ -577,14 +578,15 @@ class RaftModel:
 
         # --- HandleRequestVoteRequest (Raft.tla:360-381)
         last_t = self._last_term(d, dst)
-        ll_dst = d["log_len"][dst]
+        ll_dst = onehot_row(d["log_len"], dst)
+        vf_dst = onehot_row(d["votedFor"], dst)
         rv_logok = (u("mlastLogTerm") > last_t) | (
             (u("mlastLogTerm") == last_t) & (u("mlastLogIndex") >= ll_dst)
         )
         grant = (
             (mterm == ct_dst)
             & rv_logok
-            & ((d["votedFor"][dst] == NIL) | (d["votedFor"][dst] == src + 1))
+            & ((vf_dst == NIL) | (vf_dst == src + 1))
         )
         b_rvreq = recv & (mtype == RVREQ) & (mterm <= ct_dst)
         rhi, rlo = self._pack(
@@ -599,7 +601,9 @@ class RaftModel:
         b_rvresp = recv & (mtype == RVRESP) & (mterm == ct_dst)
         vg = jnp.where(
             u("mvoteGranted") > 0,
-            d["votesGranted"].at[dst].set(d["votesGranted"][dst] | (jnp.int32(1) << src)),
+            onehot_set(
+                d["votesGranted"], dst,
+                onehot_row(d["votesGranted"], dst) | (jnp.int32(1) << src)),
             d["votesGranted"],
         )
 
@@ -607,12 +611,12 @@ class RaftModel:
         prev_idx = u("mprevLogIndex")
         prev_term = u("mprevLogTerm")
         nent = u("nentries")
-        lt_row = d["log_term"][dst]
-        lv_row = d["log_value"][dst]
+        lt_row = onehot_row(d["log_term"], dst)
+        lv_row = onehot_row(d["log_value"], dst)
         ae_logok = (prev_idx == 0) | (
             (prev_idx > 0)
             & (prev_idx <= ll_dst)
-            & (prev_term == lt_row[jnp.clip(prev_idx - 1, 0, L - 1)])
+            & (prev_term == onehot_row(lt_row, jnp.clip(prev_idx - 1, 0, L - 1)))
         )
 
         # --- RejectAppendEntriesRequest (Raft.tla:412-430)
@@ -641,7 +645,7 @@ class RaftModel:
         if p.trunc_term_mismatch:
             # NeedsTruncation (FlexibleRaft.tla:413-416): conflicting term
             # at the incoming index; no empty-entries arm.
-            at_idx = lt_row[jnp.clip(prev_idx, 0, L - 1)]  # term at index prev+1
+            at_idx = onehot_row(lt_row, jnp.clip(prev_idx, 0, L - 1))  # term at prev+1
             needs_trunc = (nent != 0) & (ll_dst >= prev_idx + 1) & (at_idx != u("eterm"))
         else:
             needs_trunc = ((nent != 0) & (ll_dst >= prev_idx + 1)) | (
@@ -657,11 +661,13 @@ class RaftModel:
         # append m.mentries[1] if present; padding lanes stay zero.
         keep = lanes < prev_idx
         app_pos = jnp.clip(prev_idx, 0, L - 1)
-        nlt = jnp.where(keep, lt_row, 0).at[app_pos].set(
-            jnp.where(appending, u("eterm"), 0)
+        nlt = onehot_set(
+            jnp.where(keep, lt_row, 0), app_pos,
+            jnp.where(appending, u("eterm"), 0),
         )
-        nlv = jnp.where(keep, lv_row, 0).at[app_pos].set(
-            jnp.where(appending, u("evalue"), 0)
+        nlv = onehot_set(
+            jnp.where(keep, lv_row, 0), app_pos,
+            jnp.where(appending, u("evalue"), 0),
         )
         nlt = jnp.where(changes, nlt, lt_row)
         nlv = jnp.where(changes, nlv, lv_row)
@@ -679,14 +685,14 @@ class RaftModel:
         b_aeresp = recv & (mtype == AERESP) & (mterm == ct_dst)
         succm = u("msuccess") > 0
         mmatch = u("mmatchIndex")
-        ni2 = jnp.where(
-            succm,
-            d["nextIndex"].at[dst, src].set(mmatch + 1),
-            d["nextIndex"].at[dst, src].set(
-                jnp.maximum(d["nextIndex"][dst, src] - 1, 1)
-            ),
+        ni_ds = onehot_row(onehot_row(d["nextIndex"], dst), src)
+        ni2 = onehot_set2(
+            d["nextIndex"], dst, src,
+            jnp.where(succm, mmatch + 1, jnp.maximum(ni_ds - 1, 1)),
         )
-        mi2 = jnp.where(succm, d["matchIndex"].at[dst, src].set(mmatch), d["matchIndex"])
+        mi2 = jnp.where(
+            succm, onehot_set2(d["matchIndex"], dst, src, mmatch),
+            d["matchIndex"])
 
         # --- shared Reply: put the branch-selected response once ---
         resp_hi = jnp.where(b_rvreq, rhi, jnp.where(b_reject, rjhi, achi))
@@ -704,23 +710,26 @@ class RaftModel:
         # --- per-field combination (disjoint branches => order-free) ---
         upd = dict(
             currentTerm=jnp.where(
-                b_upd, d["currentTerm"].at[dst].set(mterm), d["currentTerm"]),
+                b_upd, onehot_set(d["currentTerm"], dst, mterm),
+                d["currentTerm"]),
             state=jnp.where(
-                b_upd | b_accept, d["state"].at[dst].set(FOLLOWER), d["state"]),
+                b_upd | b_accept, onehot_set(d["state"], dst, FOLLOWER),
+                d["state"]),
             votedFor=jnp.where(
-                b_upd, d["votedFor"].at[dst].set(NIL),
+                b_upd, onehot_set(d["votedFor"], dst, NIL),
                 jnp.where(b_rvreq & grant,
-                          d["votedFor"].at[dst].set(src + 1), d["votedFor"])),
+                          onehot_set(d["votedFor"], dst, src + 1),
+                          d["votedFor"])),
             votesGranted=jnp.where(b_rvresp, vg, d["votesGranted"]),
             commitIndex=jnp.where(
-                b_accept, d["commitIndex"].at[dst].set(u("mcommitIndex")),
+                b_accept, onehot_set(d["commitIndex"], dst, u("mcommitIndex")),
                 d["commitIndex"]),
             log_term=jnp.where(
-                b_accept, d["log_term"].at[dst].set(nlt), d["log_term"]),
+                b_accept, onehot_set(d["log_term"], dst, nlt), d["log_term"]),
             log_value=jnp.where(
-                b_accept, d["log_value"].at[dst].set(nlv), d["log_value"]),
+                b_accept, onehot_set(d["log_value"], dst, nlv), d["log_value"]),
             log_len=jnp.where(
-                b_accept, d["log_len"].at[dst].set(new_ll), d["log_len"]),
+                b_accept, onehot_set(d["log_len"], dst, new_ll), d["log_len"]),
             nextIndex=jnp.where(b_aeresp, ni2, d["nextIndex"]),
             matchIndex=jnp.where(b_aeresp, mi2, d["matchIndex"]),
             msg_hi=jnp.where(putb, phi, hi),
@@ -731,12 +740,15 @@ class RaftModel:
             # FollowerFsyncBeforeReply: fsyncIndex := Len(new_log)
             # (RaftFsync.tla:468-470), even when the log didn't change.
             upd["fsyncIndex"] = jnp.where(
-                b_accept, d["fsyncIndex"].at[dst].set(new_ll), d["fsyncIndex"])
+                b_accept, onehot_set(d["fsyncIndex"], dst, new_ll),
+                d["fsyncIndex"])
         if p.has_pending_response:
             upd["pendingResponse"] = jnp.where(
                 b_aeresp,
-                d["pendingResponse"].at[dst].set(
-                    d["pendingResponse"][dst] & ~(jnp.int32(1) << src)),
+                onehot_set(
+                    d["pendingResponse"], dst,
+                    onehot_row(d["pendingResponse"], dst)
+                    & ~(jnp.int32(1) << src)),
                 d["pendingResponse"])
         succ = self._asm(d, **upd)
 
