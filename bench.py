@@ -34,6 +34,92 @@ import time
 CFG = "/root/reference/specifications/standard-raft/Raft.cfg"
 
 
+def _setup_or_fallback():
+    """(model, invariants, workload label). The driver benchmark runs
+    against the reference Raft.cfg; without a reference checkout an
+    equivalent built-in 3-server geometry stands in (same S, same
+    symmetry group — the axes the rate depends on)."""
+    if os.path.exists(CFG):
+        from raft_tpu.models.registry import build_from_cfg
+        from raft_tpu.utils.cfg import parse_cfg
+
+        setup = build_from_cfg(parse_cfg(CFG), msg_slots=32)
+        return setup.model, setup.invariants, "standard-raft/Raft.cfg"
+    from raft_tpu.models.raft import RaftParams, cached_model
+
+    p = RaftParams(n_servers=3, n_values=2, max_elections=3,
+                   max_restarts=1, msg_slots=32)
+    return (cached_model(p),
+            ("LeaderHasAllAckedValues", "NoLogDivergence"),
+            "builtin raft3 (no /root/reference checkout)")
+
+
+def repro_main():
+    """--repro: two consecutive IN-PROCESS deep runs after one
+    precompile, both sustained rates recorded — the reproducibility
+    proof (VERDICT task #8). Writes BENCH_r06-style JSON to stdout;
+    the caller redirects it into the round file."""
+    depth = int(os.environ.get("BENCH_REPRO_DEPTH", "14"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "2048"))
+
+    import jax
+
+    from raft_tpu.checker.device_bfs import DeviceBFS
+
+    model, invs, workload = _setup_or_fallback()
+    t0 = time.perf_counter()
+    # FINAL capacities up front: a growth retrace in run 1 that run 2
+    # does not pay would fake a rate difference (raft3 depth 14 peaks
+    # at a ~519k frontier, ~913k seen)
+    dev = DeviceBFS(
+        model, invariants=invs, symmetry=True, chunk=chunk,
+        frontier_cap=1 << 20, seen_cap=1 << 21, journal_cap=1 << 21,
+        max_frontier_cap=1 << 21, max_seen_cap=1 << 23,
+        max_journal_cap=1 << 23,
+    )
+    dev.precompile()
+    precompile_s = time.perf_counter() - t0
+
+    # one untimed warm-up run first: the first post-precompile run
+    # page-faults the cap-sized buffers in and warms host-side caches
+    # (measured +20-35% one-off on CPU); its rate is recorded anyway
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = dev.run(max_depth=depth)
+        runs.append({
+            "distinct": res.distinct,
+            "depth": res.depth,
+            "seconds": round(time.perf_counter() - t0, 2),
+            "distinct_per_s": round(res.states_per_sec, 1),
+        })
+    warm, r1, r2 = runs
+    ratio = (r2["distinct_per_s"] / r1["distinct_per_s"]
+             if r1["distinct_per_s"] else 0.0)
+    out = {
+        "metric": "bench_repro_consecutive_runs",
+        "workload": workload,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "protocol": (
+            "one engine, one precompile, one untimed warm-up run, then "
+            f"two consecutive in-process depth-{depth} runs; nothing "
+            "compiles in the timed regions"
+        ),
+        "precompile_s": round(precompile_s, 1),
+        "warmup_run": warm,
+        "run1": r1,
+        "run2": r2,
+        "counts_match": (warm["distinct"] == r1["distinct"] == r2["distinct"]
+                         and r1["depth"] == r2["depth"]),
+        "rate_ratio": round(ratio, 4),
+        "within_10pct": bool(abs(ratio - 1.0) <= 0.10),
+    }
+    print(json.dumps(out, indent=1))
+    return 0 if out["within_10pct"] and out["counts_match"] else 1
+
+
 def measure_floor(reps: int = 5) -> float:
     """Median wall seconds of a null dispatch + device_get sync — the
     tunnel floor every wave pays once. block_until_ready does not
@@ -202,4 +288,4 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(repro_main() if "--repro" in sys.argv[1:] else main())

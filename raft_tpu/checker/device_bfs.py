@@ -49,7 +49,7 @@ from jax import lax
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
-from .lsm import pow2_at_least
+from .lsm import CanonMemo, pow2_at_least
 from .util import GROWTH, HEADROOM, I32_MAX, next_cap, probe_sorted as _probe
 
 
@@ -91,6 +91,7 @@ class DeviceBFS:
         max_seen_cap: int = 1 << 25,
         max_journal_cap: int = 1 << 25,
         fingerprint_seed: int = 0,
+        canon_memo_cap: int = 1 << 21,
     ):
         self.model = model
         self.invariants = tuple(invariants)
@@ -135,9 +136,26 @@ class DeviceBFS:
         self.canon = Canonicalizer.for_model(
             model, symmetry=symmetry, seed=fingerprint_seed
         )
-        # donated: next_buf, jparent, jcand, viol, stats (seen read-only)
-        self._chunk_fn = jax.jit(self._chunk_step, donate_argnums=(1, 2, 3, 4, 5))
-        self._wave_fn = jax.jit(self._wave_step, donate_argnums=(1, 2, 3, 4, 5))
+        # canon memo (checker/lsm.py CanonMemo geometry): HBM-resident
+        # direct-mapped table caching raw-view-hash -> canonical
+        # fingerprint across the whole run; duplicate successors (the
+        # majority past the first waves) skip the tiered canon entirely.
+        # Custom canonicalizers (make_canonicalizer models) that predate
+        # the memo surface fall back to the unmemoized path.
+        self._use_memo = (
+            canon_memo_cap > 0
+            and hasattr(self.canon, "fingerprints_memo")
+        )
+        self._memo = CanonMemo(canon_memo_cap if self._use_memo else 1)
+        self.MCAP = self._memo.MCAP
+        # donated: next_buf, jparent, jcand, viol, stats, memo
+        # (seen read-only)
+        self._chunk_fn = jax.jit(
+            self._chunk_step, donate_argnums=(1, 2, 3, 4, 5, 6)
+        )
+        self._wave_fn = jax.jit(
+            self._wave_step, donate_argnums=(1, 2, 3, 4, 5, 6)
+        )
         self._flag_true = jnp.asarray(True)
         self._flag_false = jnp.asarray(False)
         self._occ_one = jnp.ones((1,), bool)
@@ -193,16 +211,18 @@ class DeviceBFS:
     # ---------------- device programs ----------------
 
     def _chunk_step(
-        self, frontier, next_buf, jparent, jcand, viol, stats,
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo,
         cursor, fcount, base_gid, occ, first, *runs,
     ):
-        """One chunk of the current wave. stats is i64[5]:
+        """One chunk of the current wave. stats is i64[6]:
         [wave new count, journal count, cumulative generated,
-         cumulative terminal, overflow bits]; occ is bool[n_levels]
-        (probes of unoccupied levels are skipped via lax.cond); first
-        marks the wave's first chunk (resets the wave-new and overflow
-        lanes in-program, saving a per-wave host->device stats upload —
-        the tunnel's dispatch latency dominates small configs). Returns
+         cumulative terminal, overflow bits, cumulative canon memo
+         hits]; memo is the [MCAP, 2] canon memo table (threaded through
+        the wave loop, donated); occ is bool[n_levels] (probes of
+        unoccupied levels are skipped via lax.cond); first marks the
+        wave's first chunk (resets the wave-new and overflow lanes
+        in-program, saving a per-wave host->device stats upload — the
+        tunnel's dispatch latency dominates small configs). Returns
         the chunk's new fingerprints as a sorted R0-lane run."""
         model = self.model
         C, A, W, VC = self.chunk, self.A, self.W, self.VC
@@ -210,7 +230,7 @@ class DeviceBFS:
 
         stats = jnp.where(
             first,
-            stats * jnp.asarray([0, 1, 1, 1, 0], dtype=stats.dtype),
+            stats * jnp.asarray([0, 1, 1, 1, 0, 1], dtype=stats.dtype),
             stats,
         )
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
@@ -237,9 +257,17 @@ class DeviceBFS:
         )
         flatc = flatp[sel]  # [VC, W]
 
-        # 3. canonical fingerprints on compacted lanes only
-        fps = self.canon._fingerprints(flatc)
-        fps = jnp.where(selv, fps, U64_MAX)
+        # 3. canonical fingerprints on compacted lanes only, through the
+        # raw-keyed canon memo (duplicate successors skip the tiered
+        # canon; invalid lanes come back masked to U64_MAX either way)
+        if self._use_memo:
+            fps, memo, n_memo_hit = self.canon.fingerprints_memo(
+                flatc, selv, memo
+            )
+        else:
+            fps = self.canon._fingerprints(flatc)
+            fps = jnp.where(selv, fps, U64_MAX)
+            n_memo_hit = jnp.asarray(0, jnp.int32)
 
         # 4. dedup: probe every OCCUPIED LSM run, then first-occurrence in
         # chunk. Runs inserted by earlier chunks of this wave are in
@@ -305,9 +333,10 @@ class DeviceBFS:
                 stats[2] + n_gen,
                 stats[3] + terminal,
                 stats[4] | ovf_bits,
+                stats[5] + n_memo_hit,
             ]
         )
-        return next_buf, jparent, jcand, viol, stats, new_run
+        return next_buf, jparent, jcand, viol, stats, memo, new_run
 
     def _wave_geom(self) -> int:
         """Ladder depth K: levels R0<<0 .. R0<<K, top >= pow2(FCAP), so a
@@ -320,7 +349,7 @@ class DeviceBFS:
         return K
 
     def _wave_step(
-        self, frontier, next_buf, jparent, jcand, viol, stats,
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo,
         fcount, base_gid, occ, *runs,
     ):
         """One WAVE as a single dispatched program (round 5, verdict Next
@@ -330,13 +359,13 @@ class DeviceBFS:
         and syncs once, instead of paying the tunnel's per-dispatch
         service cost (~100-150 ms after compile activity) per chunk; a
         170-chunk deep wave collapses from ~170 service slots to 1.
-        Returns (next_buf, jparent, jcand, viol, stats, *ladder); the
-        host inserts the occupied ladder levels into the RunLSM."""
+        Returns (next_buf, jparent, jcand, viol, stats, memo, *ladder);
+        the host inserts the occupied ladder levels into the RunLSM."""
         C = self.chunk
         K = self._wave_geom()
         R0 = self.R0
 
-        stats = stats * jnp.asarray([0, 1, 1, 1, 0], dtype=stats.dtype)
+        stats = stats * jnp.asarray([0, 1, 1, 1, 0, 1], dtype=stats.dtype)
         occ_all = jnp.concatenate(
             [occ, jnp.ones((K + 1,), bool)]
         )  # ladder levels always probed (empties hold U64_MAX padding)
@@ -380,21 +409,24 @@ class DeviceBFS:
             )
 
         def body(carry):
-            k, next_buf, jparent, jcand, viol, stats, *ladder = carry
-            next_buf, jparent, jcand, viol, stats, new_run = self._chunk_step(
-                frontier, next_buf, jparent, jcand, viol, stats,
+            k, next_buf, jparent, jcand, viol, stats, memo, *ladder = carry
+            (next_buf, jparent, jcand, viol, stats, memo,
+             new_run) = self._chunk_step(
+                frontier, next_buf, jparent, jcand, viol, stats, memo,
                 k * C, fcount, base_gid, occ_all, jnp.asarray(False),
                 *runs, *ladder,
             )
             ladder = cascade(k, new_run, ladder)
-            return (k + 1, next_buf, jparent, jcand, viol, stats, *ladder)
+            return (k + 1, next_buf, jparent, jcand, viol, stats, memo,
+                    *ladder)
 
         def cond(carry):
             return carry[0] * C < fcount
 
         out = lax.while_loop(
             cond, body,
-            (jnp.int32(0), next_buf, jparent, jcand, viol, stats, *ladder0),
+            (jnp.int32(0), next_buf, jparent, jcand, viol, stats, memo,
+             *ladder0),
         )
         return out[1:]
 
@@ -423,9 +455,10 @@ class DeviceBFS:
             jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
             jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
             viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
-            stats = jnp.zeros((5,), jnp.int64)
+            stats = jnp.zeros((6,), jnp.int64)
             self._wave_fn(
                 frontier, next_buf, jparent, jcand, viol, stats,
+                self._memo.reset(),
                 np.int32(0), np.int32(0), self._occ_one, seen,
             )
             # per-wave seen merges this size can need (targets >= size;
@@ -534,7 +567,8 @@ class DeviceBFS:
             base_gid = int(ck["base_gid"])
             gen_prev = int(ck["gen_prev"])
             depth_counts = list(ck["depth_counts"])
-            stats0 = np.array([0, jcount, gen_prev, terminal, 0], dtype=np.int64)
+            stats0 = np.array([0, jcount, gen_prev, terminal, 0, 0],
+                              dtype=np.int64)
         else:
             violation = self._check_init(init_d)
             self._seed_seen(np.sort(init_fps[keep]))
@@ -549,7 +583,7 @@ class DeviceBFS:
             base_gid = 0
             depth_counts = [n0]
             gen_prev = 0
-            stats0 = np.zeros((5,), dtype=np.int64)
+            stats0 = np.zeros((6,), dtype=np.int64)
 
         # Buffers are allocated ON DEVICE and only the real rows upload:
         # the tunnel moves ~25-35 MB/s, so the round-4 host-built
@@ -574,6 +608,11 @@ class DeviceBFS:
                 (jnp.int32(0),))
         viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
         stats = jnp.asarray(stats0)
+        # fresh memo per run: the table is a pure cache (its contents
+        # never change a fingerprint), but starting cold keeps
+        # back-to-back runs of one engine instance comparable
+        memo = self._memo.reset()
+        memo_prev = 0
 
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
@@ -623,12 +662,12 @@ class DeviceBFS:
             # below AFTER the overflow check (so an aborted wave leaves
             # the seen-set untouched and the run trivially resumable)
             out = self._wave_fn(
-                frontier, next_buf, jparent, jcand, viol, stats,
+                frontier, next_buf, jparent, jcand, viol, stats, memo,
                 np.int32(fcount), np.int32(base_gid),
                 self._occ_one, self._seen,
             )
-            next_buf, jparent, jcand, viol, stats = out[:5]
-            ladder = out[5:]
+            next_buf, jparent, jcand, viol, stats, memo = out[:6]
+            ladder = out[6:]
             # one host round-trip per wave: stats and the invariant fold
             # fetched together (two device_gets double the tunnel RTT on
             # small configs, where per-wave latency dominates)
@@ -699,6 +738,9 @@ class DeviceBFS:
                     gen_prev, depth_counts,
                 )
                 last_ckpt = time.perf_counter()
+            memo_hits = int(stats_h[5])
+            wave_memo = memo_hits - memo_prev
+            memo_prev = memo_hits
             if metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
@@ -707,6 +749,10 @@ class DeviceBFS:
                     "new": ncount,
                     "generated": wave_gen,
                     "dedup_hit_rate": round(1.0 - ncount / max(1, wave_gen), 4),
+                    "canon_memo_hits": wave_memo,
+                    "canon_memo_hit_rate": round(
+                        wave_memo / max(1, wave_gen), 4
+                    ),
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "distinct_per_s": round(distinct / el, 1),
                     "lsm_runs": 1,
@@ -733,6 +779,11 @@ class DeviceBFS:
         self._jparent = jparent
         self._jcand = jcand
         self._jcount = int(np.asarray(jax.device_get(stats))[1])
+        # keep the run-final memo resident: the donated input buffers are
+        # dead, but the last wave's OUTPUT table is live — the profiler
+        # times the memoized canon against this realistically-warmed
+        # table (checker/profile.py)
+        self._memo.table = memo
 
         dt = time.perf_counter() - t0
         trace = self.reconstruct_trace(violation) if violation else None
@@ -757,15 +808,19 @@ class DeviceBFS:
         match too — states explored before the checkpoint (including Init)
         were only checked against the original run's invariants, so a
         resume with different invariants would silently skip them."""
-        # hashv marks fingerprint-formula revisions. v4 (round 5: u32
-        # stream-pair mixing + additive bag multiset combine,
-        # ops/hashing.py + ops/symmetry.py) changed every fingerprint, so
-        # all pre-v4 checkpoints are refused on load — conservative and
-        # sound.
+        # hashv marks fingerprint-formula revisions. v5 (round 6: the
+        # 1-WL signature refinement iterates to a bounded depth, which
+        # changes the admissible permutation set — and therefore the
+        # canonical representative — of signature-tied states), so all
+        # pre-v5 checkpoints are refused on load; the refinement depth
+        # is part of the formula and recorded alongside. The canon memo
+        # and the tie-group-local tier-3 are value-preserving and do
+        # NOT participate in the identity.
+        wl = getattr(self.canon, "refine_rounds", 1)
         return (
             f"{self.model.name}/{self.model.p}/W={self.W}"
             f"/sym={self.canon.symmetry}/seed={self.canon.seed}"
-            f"/hashv=4/inv={','.join(self.invariants)}"
+            f"/hashv=5/wl={wl}/inv={','.join(self.invariants)}"
         )
 
     def _save_checkpoint(

@@ -10,20 +10,34 @@ the profiler rebuilds the chunk inputs from it). Stages mirror
                  row also pays once (subtract it when reading raw ms)
   expand       vmap of the per-action successor kernels
   compact      valid-lane compaction (cumsum + one-hot select)
-  canon        VIEW + SYMMETRY canonical fingerprints (the P-permutation
-               reduction — the 5-server hot spot, SURVEY.md §7.2)
-  probe        membership probe of every LSM seen-run (searchsorted each)
+  canon        MEMOIZED canonical fingerprints against the warm run's
+               live memo table — the realistic mixed hit/miss path a
+               production chunk pays (probe + tiered canon of the
+               misses + insert). Unmemoized canonicalizers time the
+               plain tiered canon here instead.
+  canon_memo_hit  the same memoized call against a table that already
+               holds every key of this chunk — the pure-hit floor
+               (one raw hash + probe, no tiered canon at all)
+  canon_tier3_local  the tier-3 resolve alone (tie-group-local blocks +
+               full-table drain, ops/symmetry.py _tier3_apply) with
+               tiers 1+2 precomputed outside the timer; 0.0 when the
+               canonicalizer has no pruned tier path
+  probe        membership probe of the seen run (searchsorted)
   run_emit     sorting the chunk's new fingerprints into its R0-lane run
   scatter      next-frontier + journal scatter
   invariants   batched invariant kernels
-  lsm_merge_2r0  one level-0 run merge (sort of 2*R0 lanes); the cascade
-                 triggers a level-l merge every 2^(l+1) chunks, so the
-                 AMORTIZED per-chunk merge cost (reported in per_wave_s)
-                 is a short geometric-ish series fitted from this point
+  lsm_merge_2r0  one R0+R0 run merge (sort of 2*R0 lanes), fitting the
+                 n log n constant for the AMORTIZED per-chunk merge cost
 
 Per-wave cost model: chunks_per_wave * (fused chunk + amortized merge).
 ``fused_chunk`` times the production program for cross-checking (the sum
 of stages normally OVERESTIMATES it — XLA fuses away intermediates).
+The per-chunk stage sum counts PRODUCTION stages once: canon_memo_hit
+and canon_tier3_local are diagnostic re-measures of sub-paths already
+inside the ``canon`` row (the all-hit floor and the tier-3 resolve), so
+they are reported — their visibility is the point — but excluded from
+the sum and from ``canon_share_of_stage_sum``, which would otherwise
+triple-count canon work.
 """
 
 from __future__ import annotations
@@ -40,6 +54,24 @@ import numpy as np
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64
 from .device_bfs import DeviceBFS
 from .util import probe_sorted as _probe
+
+# every stage key profile_stages() promises to report (the tier-1 smoke
+# test asserts each one is present so stage accounting can't silently
+# rot when the chunk pipeline changes)
+DECLARED_STAGES = (
+    "null_dispatch",
+    "expand",
+    "compact",
+    "canon",
+    "canon_memo_hit",
+    "canon_tier3_local",
+    "probe",
+    "run_emit",
+    "scatter",
+    "invariants",
+    "lsm_merge_2r0",
+    "fused_chunk",
+)
 
 
 def _time(fn, *args, reps: int = 5, inner: int = 1) -> float:
@@ -100,11 +132,13 @@ def profile_stages(
             [batch_h, np.repeat(batch_h[-1:], C - len(batch_h), axis=0)]
         )
     batch = jnp.asarray(batch_h)
-    # the warmed seen-set as LSM runs (same layout production probes)
-    dev._lsm.seed(np.sort(seen_h.astype(np.uint64)))
-    runs = tuple(dev._lsm.runs)
-    occ_dev = jnp.asarray(np.asarray(dev._lsm.occ, dtype=bool))
-    occ_runs = tuple(r for r, o in zip(dev._lsm.runs, dev._lsm.occ) if o)
+    # the warmed seen-set as the single sorted run production probes
+    # (round-5 seen design: one U64_MAX-padded run, no LSM ladder)
+    dev._seed_seen(np.sort(seen_h.astype(np.uint64)))
+    runs = (dev._seen,)
+    occ_dev = dev._occ_one
+    occ_runs = runs
+    use_memo = getattr(dev, "_use_memo", False)
 
     out: dict = {
         "workload": {
@@ -118,6 +152,8 @@ def profile_stages(
             "chunk": C, "A": A, "W": W, "VC": VC, "R0": R0,
             "FCAP": FCAP, "JCAP": JCAP, "lsm_levels": len(runs),
             "perms": int(dev.canon.P), "symmetry": bool(symmetry),
+            "canon_memo_cap": int(dev.MCAP) if use_memo else 0,
+            "refine_rounds": int(getattr(dev.canon, "refine_rounds", 1)),
         },
         "stages_s": {},
     }
@@ -153,9 +189,37 @@ def profile_stages(
     flatc, selv = compact_j(succs, valid)
 
     # ---- stage 3: canonical fingerprints ----
-    canon_j = jax.jit(dev.canon._fingerprints)
-    st["canon"] = _time(canon_j, flatc, reps=reps)
-    fps = jnp.where(selv, canon_j(flatc), U64_MAX)
+    if use_memo:
+        fmemo = jax.jit(dev.canon.fingerprints_memo)
+        # the warm run left its LAST wave's memo table resident
+        # (DeviceBFS.run keeps the final output buffer) — timing
+        # against it is the realistic mixed hit/miss path
+        m_warm = dev._memo.table
+        st["canon"] = _time(fmemo, flatc, selv, m_warm, reps=reps)
+        fps, m_hit, _ = fmemo(flatc, selv, m_warm)
+        # after one pass the table holds every key of this chunk: the
+        # second call is the pure-hit floor
+        st["canon_memo_hit"] = _time(fmemo, flatc, selv, m_hit, reps=reps)
+    else:
+        canon_j = jax.jit(dev.canon._fingerprints)
+        st["canon"] = _time(canon_j, flatc, reps=reps)
+        fps = jnp.where(selv, canon_j(flatc), U64_MAX)
+        st["canon_memo_hit"] = 0.0
+
+    # ---- stage 3b: tier-3 resolve alone (tie-group-local + full-table
+    # drain), with the tier-1/2 running min precomputed outside ----
+    c = dev.canon
+    if (
+        c.symmetry and getattr(c, "prune", False)
+        and getattr(c, "mode", "full") != "full"
+    ):
+        view = flatc[:, : c.VL]
+        sig = jax.jit(c._signatures)(view)
+        pre = jax.jit(c._tier_pre)(view, sig)
+        t3_j = jax.jit(c._tier3_apply)
+        st["canon_tier3_local"] = _time(t3_j, view, sig, *pre, reps=reps)
+    else:
+        st["canon_tier3_local"] = 0.0
 
     # ---- stage 4: probe the occupied LSM runs (production skips empty
     # levels via cond, so the occupied set is what a chunk pays for) ----
@@ -222,14 +286,17 @@ def profile_stages(
     )
 
     def fused_once():
-        # donated args (next_buf, journal, viol, stats) must be rebuilt
-        # per call — donation invalidates their buffers
+        # donated args (next_buf, journal, viol, stats, memo) must be
+        # rebuilt per call — donation invalidates their buffers. The
+        # memo is a COPY of the warm table so the fused row reflects the
+        # production mixed hit/miss path.
         nb = jnp.zeros((FCAP + 1, W), jnp.int32)
         jp = jnp.zeros((JCAP + 1,), jnp.int32)
         jc = jnp.zeros((JCAP + 1,), jnp.int32)
         viol = jnp.full((max(1, len(invariants)),), np.int32(2**31 - 1), jnp.int32)
-        stats = jnp.zeros((5,), jnp.int64)
-        args = [frontier_d, nb, jp, jc, viol, stats,
+        stats = jnp.zeros((6,), jnp.int64)
+        memo = jnp.array(m_warm) if use_memo else dev._memo.reset()
+        args = [frontier_d, nb, jp, jc, viol, stats, memo,
                 np.int32(0), np.int32(min(fcount, C)), np.int32(0),
                 occ_dev, jnp.asarray(True), *runs]
         jax.block_until_ready(args)
@@ -241,16 +308,26 @@ def profile_stages(
     fused_once()  # compile
     st["fused_chunk"] = float(np.median([fused_once() for _ in range(reps)]))
 
-    timed = ["expand", "compact", "canon", "probe", "run_emit", "scatter"]
+    # PRODUCTION stages only: canon_memo_hit / canon_tier3_local re-time
+    # sub-paths already inside the `canon` row (the all-hit floor and the
+    # tier-3 resolve), so adding them would triple-count canon work. A
+    # chunk pays `canon` once — that row is the mixed hit/miss path.
+    timed = [
+        "expand", "compact", "canon", "probe", "run_emit", "scatter",
+    ]
     if invariants:
         timed.append("invariants")
-    # each TIMED stage row pays one dispatch floor
-    chunk_sum = sum(st[k] for k in timed) - len(timed) * null
+    # each TIMED stage row pays one dispatch floor (floored at 0 so a
+    # not-applicable 0.0 stage can't subtract from the sum)
+    chunk_sum = sum(max(0.0, st[k] - null) for k in timed)
     n_chunks = max(1, (fcount + C - 1) // C)
     per_chunk = st["fused_chunk"] + amortized
+    canon_sum = max(0.0, st["canon"] - null)
     out["per_wave_s"] = {
         "chunks_per_wave": n_chunks,
         "stage_sum_per_chunk": round(chunk_sum, 6),
+        "canon_share_of_stage_sum": round(
+            canon_sum / chunk_sum, 4) if chunk_sum else 0.0,
         "fused_per_chunk": round(st["fused_chunk"], 6),
         "lsm_merge_amortized_per_chunk": round(amortized, 6),
         "wave_estimate": round(n_chunks * per_chunk, 6),
@@ -270,11 +347,19 @@ def render(prof: dict) -> str:
         f"{'stage':<16}{'ms':>10}{'share':>8}",
     ]
     skip = ("fused_chunk", "lsm_merge_2r0", "null_dispatch")
+    # diagnostic re-measures of canon sub-paths: shown (relative to the
+    # production sum) but not part of it — see per_wave_s accounting
+    diag = ("canon_memo_hit", "canon_tier3_local")
     null = s.get("null_dispatch", 0.0)
-    tot = sum(max(0.0, v - null) for k, v in s.items() if k not in skip)
+    tot = sum(max(0.0, v - null) for k, v in s.items()
+              if k not in skip and k not in diag)
     for k, v in s.items():
         share = max(0.0, v - null) / tot if k not in skip and tot else 0
-        lines.append(f"{k:<16}{v * 1e3:>10.2f}{share:>8.1%}")
+        mark = "*" if k in diag else ""
+        lines.append(f"{k + mark:<16}{v * 1e3:>10.2f}{share:>8.1%}")
+    if any(k in s for k in diag):
+        lines.append("(* diagnostic re-measure of a canon sub-path; "
+                     "not in the stage sum)")
     pw = prof["per_wave_s"]
     lines.append(
         f"wave: {pw['chunks_per_wave']} chunks x "

@@ -10,6 +10,22 @@ Reproduces TLC's distinct-state semantics for cfgs that declare
   - SYMMETRY: two states related by a server permutation are the same
     distinct state (``Raft.tla:116``).
 
+Fingerprint formula v5 (round 6): v4 below with the 1-WL signature
+refinement iterated to a bounded depth (``refine_rounds``, default 3)
+instead of exactly one round. Deeper refinement shrinks tie groups of
+size >= 3 before any permutation is enumerated, but it also changes
+WHICH permutations are admissible for still-tied states — so the masked
+min lands on a different (equally canonical) orbit representative and
+tied-state fingerprints changed vs v4 (hashv=5 in the checkpoint
+identity, with the round count recorded alongside). Canonicalization
+itself is restructured around three compounding optimisations, all
+value-preserving given the signature: a direct-mapped canon memo table
+keyed by the raw (identity-permutation) view hash
+(``fingerprints_memo``), tie-group-LOCAL masked mins over per-pattern
+static tables for lanes whose tie groups stay small, and an adaptive
+blocked ``lax.while_loop`` budget replacing the old static ``B//8``
+compaction + whole-batch ``lax.cond`` fallback.
+
 Fingerprint formula v4 (round 5): identical STRUCTURE to v3 below, but
 all mixing arithmetic runs as two independent u32 streams combined into
 one u64 at the end (u64 multiplies/compares are ~400x/180x slow on this
@@ -45,11 +61,16 @@ the slot-sorted view):
 
   Per chunk the kernel computes the fast single-permutation fingerprint
   for every lane (tier 1), resolves tie groups of size <= 2 with the
-  static disjoint-adjacent-swap tables (tier 2), compacts the rare
-  lanes holding a tie group >= 3 (budget = B//8) through the static
-  S!-table masked min (tier 3), and falls back to the masked min on
-  ALL lanes via ``lax.cond`` when a batch is heavy-tie-dense (early
-  BFS waves, where frontiers are tiny anyway).
+  static disjoint-adjacent-swap tables (tier 2), and routes the rare
+  lanes holding a tie group >= 3 through tier 3: lanes whose tie
+  PATTERN has a small admissible block-permutation group (<= the
+  largest non-full pattern, e.g. 24 perms at S=5) take the
+  tie-group-LOCAL masked min over a per-pattern static table composed
+  with the argsort; only all-tied lanes (admissible group = the full
+  S!) still pay the S!-table masked min. Both tier-3 buckets drain
+  through fixed-size blocks inside a ``lax.while_loop`` whose trip
+  count adapts to the actual heavy-lane count — no static budget, no
+  whole-batch fallback cliff.
 
 A permutation sigma acts on the packed view as: row gathers for
 server-indexed axes, value remaps for server-valued fields and bitmasks,
@@ -85,8 +106,11 @@ from .hashing import (
     eq_u64,
     ge_u64,
     hash_lanes_pair,
+    memo_slot,
     mix32,
+    ne_u64,
     seed_salts,
+    sort_u64_with_idx,
 )
 from .packing import EMPTY, BitPacker, WidePacker
 from ..models.base import Layout
@@ -180,10 +204,74 @@ def _adj_swap_products(S: int):
     return np.array(perms, np.int32), np.array(masks, bool)
 
 
+def _tie_pattern_groups(S: int, pat: int) -> list[list[int]]:
+    """Sorted-position tie groups of an adjacent-equality bit pattern
+    (bit j set <=> sorted positions j and j+1 hold equal signatures)."""
+    groups, cur = [], [0]
+    for j in range(S - 1):
+        if (pat >> j) & 1:
+            cur.append(j + 1)
+        else:
+            groups.append(cur)
+            cur = [j + 1]
+    groups.append(cur)
+    return groups
+
+
+def _tie_pattern_tables(S: int):
+    """Per-tie-pattern admissible block permutations of the SORTED
+    positions (products of per-group symmetric groups), for the
+    tie-group-local tier-3 min.
+
+    Returns (tab [NP, LCAP, S] int32, mask [NP, LCAP] bool,
+    local [NP] bool) over the NP = 2^(S-1) adjacent-equality patterns.
+    LCAP is the largest admissible-group size among patterns that
+    contain a tie group >= 3 but are not all-tied (24 at S=5: the
+    {4,1} pattern). Every pattern whose group fits in LCAP is marked
+    ``local`` and its table rows enumerate the COMPLETE admissible set
+    (identity included), so the local min is exactly the masked
+    full-S! min for those lanes; the rest (the all-tied pattern at
+    S=5) route to the full S!-table path."""
+    NP = 1 << (S - 1)
+    nfull = math.factorial(S)
+    counts = []
+    for pat in range(NP):
+        groups = _tie_pattern_groups(S, pat)
+        counts.append(int(np.prod([math.factorial(len(g)) for g in groups])))
+    lcap = max(
+        (c for pat, c in enumerate(counts)
+         if c < nfull
+         and max(len(g) for g in _tie_pattern_groups(S, pat)) >= 3),
+        default=1,
+    )
+    tab = np.tile(np.arange(S, dtype=np.int32), (NP, lcap, 1))
+    mask = np.zeros((NP, lcap), dtype=bool)
+    local = np.zeros(NP, dtype=bool)
+    for pat in range(NP):
+        if counts[pat] > lcap:
+            continue
+        local[pat] = True
+        groups = _tie_pattern_groups(S, pat)
+        row = 0
+        for combo in itertools.product(
+            *[itertools.permutations(g) for g in groups]
+        ):
+            p = np.arange(S, dtype=np.int32)
+            for g, pg in zip(groups, combo):
+                for j, tgt in zip(g, pg):
+                    p[j] = tgt
+            tab[pat, row] = p
+            mask[pat, row] = True
+            row += 1
+        assert row == counts[pat]
+    return tab, mask, local
+
+
 class Canonicalizer:
     @classmethod
     def for_model(cls, model, symmetry: bool = True, seed: int = 0,
-                  mode: str = "auto") -> "Canonicalizer":
+                  mode: str = "auto",
+                  refine_rounds: int = 3) -> "Canonicalizer":
         """Build from a model's declared message-field symmetry contract
         (keeps the model -> canonicalization plumbing in one place).
 
@@ -208,6 +296,7 @@ class Canonicalizer:
             symmetry=symmetry,
             seed=seed,
             mode=mode,
+            refine_rounds=refine_rounds,
         )
 
     def __init__(
@@ -220,6 +309,7 @@ class Canonicalizer:
         symmetry: bool = True,
         seed: int = 0,
         mode: str = "auto",
+        refine_rounds: int = 3,
     ):
         from .. import enable_compcache
 
@@ -232,6 +322,13 @@ class Canonicalizer:
         self.packer = packer
         self.symmetry = symmetry
         self.mode = mode
+        # 1-WL refinement depth: part of the fingerprint formula (it
+        # selects the admissible permutation set for tied states), so it
+        # is fixed per canonicalizer and recorded in the checkpoint
+        # identity (hashv=5/wl=k). k=3 empirically reaches the fixpoint
+        # on the raft workloads; every round is equivariant, so any k
+        # yields a correct (bit-self-consistent) canonical form.
+        self.refine_rounds = max(1, int(refine_rounds))
         # fingerprint hash seed: a second independent hash family for the
         # collision audit (checker/audit.py)
         self.seed = seed
@@ -366,6 +463,12 @@ class Canonicalizer:
             tperms, tmask = _adj_swap_products(S)
             self._t_sigma = jnp.asarray(tperms)  # [T, S] for composition
             self._t_edge_mask = jnp.asarray(tmask)  # [T, S-1]
+            # tier-3 tie-pattern tables: complete admissible block-perm
+            # sets for every pattern small enough to enumerate locally
+            ptab, pmask, plocal = _tie_pattern_tables(S)
+            self._p_tab = jnp.asarray(ptab)  # [NP, LCAP, S]
+            self._p_mask = jnp.asarray(pmask)  # [NP, LCAP]
+            self._p_local = jnp.asarray(plocal)  # [NP]
         self.fingerprints = jax.jit(self._fingerprints)
 
     def _np_gidx(self, perms: np.ndarray) -> np.ndarray:
@@ -475,14 +578,18 @@ class Canonicalizer:
 
     # ---------------- equivariant per-server signatures ----------------
 
-    def _signatures(self, view):
+    def _signatures(self, view, rounds: int | None = None):
         """[B, VL] -> u64 [B, S] permutation-EQUIVARIANT signatures:
         sig(perm(x))[sigma(i)] == sig(x)[i]. Built from per-server
-        invariant content plus one 1-WL refinement round; every fold is
-        either self-relative or an unordered multiset sum, and no fold
-        reads a raw server index. All mixing runs as u32 stream pairs
-        (v4 — u64 multiplies are ~400x slow on this TPU, hashing.py);
-        the streams combine into one orderable u64 at the very end."""
+        invariant content plus ``rounds`` 1-WL refinement rounds
+        (default ``self.refine_rounds``); every fold is either
+        self-relative or an unordered multiset sum, and no fold reads a
+        raw server index — each round preserves equivariance, so any
+        depth yields a correct admissible set. All mixing runs as u32
+        stream pairs (v4 — u64 multiplies are ~400x slow on this TPU,
+        hashing.py); the streams combine into one orderable u64 at the
+        very end. ``rounds=1`` reproduces the v4 signature exactly
+        (round-0 fold salts are depth-offset only for r >= 1)."""
         S, B = self.S, view.shape[0]
         srange = jnp.arange(S, dtype=jnp.int32)
         acc = (jnp.zeros((B, S), jnp.uint32), jnp.zeros((B, S), jnp.uint32))
@@ -564,66 +671,78 @@ class Canonicalizer:
 
         sig0 = (mix32(acc[0]), mix32(acc[1]))
 
-        # ---- refinement: fold neighbor signatures ----
-        acc1 = (jnp.zeros((B, S), jnp.uint32), jnp.zeros((B, S), jnp.uint32))
-        for off, vals in val_fields:
-            tgt = jnp.clip(vals - 1, 0, S - 1)
-            nsig = _pgather(sig0, tgt)
-            valid = (vals > 0) & (vals - 1 != srange)
-            sa, sb = _salt(off, 9)
-            acc1 = _padd(
-                acc1,
-                _pwhere(valid, (mix32(nsig[0] ^ sa), mix32(nsig[1] ^ sb))),
-            )
-        for off, masks in bm_fields:
-            bits = ((masks[:, :, None] >> srange[None, None, :]) & 1) == 1
-            sa, sb = _salt(off, 10)
-            e = (mix32(sig0[0] ^ sa), mix32(sig0[1] ^ sb))  # [B, S]
-            contrib = _pwhere(
-                bits,
-                (
-                    jnp.broadcast_to(e[0][:, None, :], bits.shape),
-                    jnp.broadcast_to(e[1][:, None, :], bits.shape),
-                ),
-            )
-            acc1 = _padd(acc1, _psum_last(contrib))
-        for off, mat in pair_fields:
-            sa, sb = _salt(off, 11)
-            m32 = mat.astype(jnp.uint32)
-            era = mix32(m32 * KA + (sig0[0] ^ sa)[:, None, :])
-            erb = mix32(m32 * KB + (sig0[1] ^ sb)[:, None, :])
-            acc1 = _padd(acc1, _psum_last((era, erb)))
-            sa2, sb2 = _salt(off, 12)
-            mt32 = mat.transpose(0, 2, 1).astype(jnp.uint32)
-            eca = mix32(mt32 * KA + (sig0[0] ^ sa2)[:, None, :])
-            ecb = mix32(mt32 * KB + (sig0[1] ^ sb2)[:, None, :])
-            acc1 = _padd(acc1, _psum_last((eca, ecb)))
-        if msg is not None:
-            words, cnt32, occ, rec0 = msg
-            # per-slot fold of every referenced server's sig0, then
-            # re-scatter: binds a record's endpoints together
-            svals = []
-            osum = (jnp.zeros_like(rec0[0]), jnp.zeros_like(rec0[1]))
-            for k, (fname, kind) in enumerate(self.msg_perm_spec):
-                val = self._unpack_key(words, fname)
-                svals.append(val)
-                osum = _padd(
-                    osum, self._gather_sig_fold(sig0, val, kind, _salt(k, 13))
+        # ---- refinement: fold neighbor signatures, k rounds ----
+        def refine(sigp, r):
+            rr = 32 * r  # depth-offset every fold salt past round 0
+            acc1 = (jnp.zeros((B, S), jnp.uint32),
+                    jnp.zeros((B, S), jnp.uint32))
+            for off, vals in val_fields:
+                tgt = jnp.clip(vals - 1, 0, S - 1)
+                nsig = _pgather(sigp, tgt)
+                valid = (vals > 0) & (vals - 1 != srange)
+                sa, sb = _salt(off, 9 + rr)
+                acc1 = _padd(
+                    acc1,
+                    _pwhere(valid, (mix32(nsig[0] ^ sa), mix32(nsig[1] ^ sb))),
                 )
-            for k, (fname, kind) in enumerate(self.msg_perm_spec):
-                # exclude the target's own contribution so its fold is
-                # over the OTHER endpoints
-                own = self._gather_sig_fold(sig0, svals[k], kind, _salt(k, 13))
-                sa, sb = _salt(k, 14)
-                c = (
-                    cnt32 * mix32(rec0[0] + (osum[0] - own[0]) + sa),
-                    cnt32 * mix32(rec0[1] + (osum[1] - own[1]) + sb),
+            for off, masks in bm_fields:
+                bits = ((masks[:, :, None] >> srange[None, None, :]) & 1) == 1
+                sa, sb = _salt(off, 10 + rr)
+                e = (mix32(sigp[0] ^ sa), mix32(sigp[1] ^ sb))  # [B, S]
+                contrib = _pwhere(
+                    bits,
+                    (
+                        jnp.broadcast_to(e[0][:, None, :], bits.shape),
+                        jnp.broadcast_to(e[1][:, None, :], bits.shape),
+                    ),
                 )
-                acc1 = _padd(acc1, self._scatter_by_server(c, svals[k], kind, occ))
+                acc1 = _padd(acc1, _psum_last(contrib))
+            for off, mat in pair_fields:
+                sa, sb = _salt(off, 11 + rr)
+                m32 = mat.astype(jnp.uint32)
+                era = mix32(m32 * KA + (sigp[0] ^ sa)[:, None, :])
+                erb = mix32(m32 * KB + (sigp[1] ^ sb)[:, None, :])
+                acc1 = _padd(acc1, _psum_last((era, erb)))
+                sa2, sb2 = _salt(off, 12 + rr)
+                mt32 = mat.transpose(0, 2, 1).astype(jnp.uint32)
+                eca = mix32(mt32 * KA + (sigp[0] ^ sa2)[:, None, :])
+                ecb = mix32(mt32 * KB + (sigp[1] ^ sb2)[:, None, :])
+                acc1 = _padd(acc1, _psum_last((eca, ecb)))
+            if msg is not None:
+                words, cnt32, occ, rec0 = msg
+                # per-slot fold of every referenced server's sig, then
+                # re-scatter: binds a record's endpoints together
+                svals = []
+                osum = (jnp.zeros_like(rec0[0]), jnp.zeros_like(rec0[1]))
+                for k, (fname, kind) in enumerate(self.msg_perm_spec):
+                    val = self._unpack_key(words, fname)
+                    svals.append(val)
+                    osum = _padd(
+                        osum,
+                        self._gather_sig_fold(sigp, val, kind,
+                                              _salt(k, 13 + rr)),
+                    )
+                for k, (fname, kind) in enumerate(self.msg_perm_spec):
+                    # exclude the target's own contribution so its fold
+                    # is over the OTHER endpoints
+                    own = self._gather_sig_fold(sigp, svals[k], kind,
+                                                _salt(k, 13 + rr))
+                    sa, sb = _salt(k, 14 + rr)
+                    c = (
+                        cnt32 * mix32(rec0[0] + (osum[0] - own[0]) + sa),
+                        cnt32 * mix32(rec0[1] + (osum[1] - own[1]) + sb),
+                    )
+                    acc1 = _padd(
+                        acc1,
+                        self._scatter_by_server(c, svals[k], kind, occ),
+                    )
+            return (mix32(sigp[0] + mix32(acc1[0])),
+                    mix32(sigp[1] + mix32(acc1[1])))
 
-        fa = mix32(sig0[0] + mix32(acc1[0]))
-        fb = mix32(sig0[1] + mix32(acc1[1]))
-        return combine_pair(fa, fb)
+        sigp = sig0
+        for r in range(self.refine_rounds if rounds is None else rounds):
+            sigp = refine(sigp, r)
+        return combine_pair(sigp[0], sigp[1])
 
     def _scatter_by_server(self, contrib, val, kind, occ):
         """Sum [B, M] stream-pair contributions onto the servers
@@ -945,8 +1064,10 @@ class Canonicalizer:
         signature machinery costs more than it saves at 6-24 perms,
         measured on the TPU); S >= 5 -> signature-pruned masked min
         (at 120+ perms the brute force is ~9x the whole chunk budget)."""
-        view = states[:, : self.VL]
-        B = view.shape[0]
+        return self._canon_view(states[:, : self.VL])
+
+    def _canon_view(self, view):
+        """Tiered canonical hash of a [B, VL] view batch."""
         if not self.symmetry:
             return self._perm_hash(view)
         if not self.prune:
@@ -954,6 +1075,18 @@ class Canonicalizer:
         sig = self._signatures(view)
         if self.mode == "full":
             return self._masked_min(view, sig)
+        pre = self._tier_pre(view, sig)
+        return self._tier3_apply(view, sig, *pre)
+
+    def _tier_pre(self, view, sig):
+        """Tiers 1+2 plus tie-pattern classification. Returns
+        ``(fp, sigma, pat, is_local, is_full)``: the running min after
+        the signature-argsort permutation (tier 1) and the static
+        disjoint-adjacent-swap products (tier 2), the tier-1 sigma, each
+        lane's adjacent-equality pattern id, and the two tier-3 route
+        masks (tie group >= 3 with a locally enumerable admissible
+        set / needing the full S! table)."""
+        S = self.S
 
         # ---- tier 1: one dynamic permutation (the signature argsort) ----
         order = jnp.argsort(sig, axis=1).astype(jnp.int32)  # = inv
@@ -972,7 +1105,7 @@ class Canonicalizer:
         comp = jnp.zeros(
             (self._t_sigma.shape[0],) + sigma.shape, jnp.int32
         )  # [T, B, S]
-        for u in range(self.S):
+        for u in range(S):
             comp = comp + jnp.where(
                 sigma[None] == u, self._t_sigma[:, u, None, None], 0
             )
@@ -984,41 +1117,191 @@ class Canonicalizer:
             fp, jnp.min(jnp.where(t_valid, t_fps, U64_MAX), axis=0)
         )
 
-        # ---- tier 3: states with a tie group >= 3 (a run of 2+ adjacent
-        # equalities) need the masked full-table min; they are rare past
-        # the first waves (~1.5% at depth 10 on the 5-server workload),
-        # so compact them into a small buffer. A tie-heavy batch (early
-        # BFS, tiny frontiers) falls back to the full path wholesale.
+        # ---- tie classification for tier 3: a lane is heavy iff some
+        # tie group has size >= 3 (a run of 2+ adjacent equalities);
+        # its adjacent-equality PATTERN decides the route: every
+        # pattern whose admissible block-perm group fits the static
+        # per-pattern tables takes the tie-group-local min, the rest
+        # (all-tied lanes at S=5) take the full S!-table masked min.
         heavy = jnp.any(adj_eq[:, :-1] & adj_eq[:, 1:], axis=1)
-        # B//8: the AVERAGE heavy rate past depth ~9 on the 5-server
-        # workload is ~1.5%, but heavy states cluster within chunks
-        # (frontier slots follow discovery order), so a tighter B//16
-        # budget pushed many real chunks into the full-table fallback —
-        # measured 2.7x slower canon at depth 9/10 than B//8
-        TCH = max(64, B // 8)
-        n_heavy = jnp.sum(heavy)
+        shifts = jnp.arange(S - 1, dtype=jnp.int32)
+        pat = jnp.sum(
+            adj_eq.astype(jnp.int32) << shifts[None, :], axis=1
+        ).astype(jnp.int32)
+        loc = self._p_local[pat]
+        return fp, sigma, pat, heavy & loc, heavy & ~loc
 
-        def compact_heavy(_):
-            hpos = (jnp.cumsum(heavy) - 1).astype(jnp.int32)
-            hdst = jnp.where(heavy, jnp.minimum(hpos, TCH), TCH)
-            hsel = (
-                jnp.full((TCH + 1,), B, jnp.int32)
-                .at[hdst]
-                .set(jnp.arange(B, dtype=jnp.int32))[:TCH]
-            )
-            hselv = hsel < B
-            viewp = jnp.concatenate(
-                [view, jnp.zeros((1, self.VL), view.dtype)], axis=0
-            )
-            sigp = jnp.concatenate(
-                [sig, jnp.zeros((1, self.S), sig.dtype)], axis=0
-            )
-            heavy_fps = self._masked_min(viewp[hsel], sigp[hsel])  # [TCH]
-            fpp = jnp.concatenate([fp, jnp.zeros((1,), jnp.uint64)])
-            dst = jnp.where(hselv, hsel, B)
-            return fpp.at[dst].set(jnp.where(hselv, heavy_fps, 0))[:B]
+    def _tier3_apply(self, view, sig, fp, sigma, pat, is_local, is_full):
+        """Resolve the tier-3 lanes of ``_tier_pre``'s classification:
+        both buckets drain through fixed-size blocks inside a
+        ``lax.while_loop`` whose trip count adapts to the actual heavy
+        population of the chunk — no static compaction budget, no
+        whole-batch ``lax.cond`` fallback cliff."""
+        fp = self._tier3_local(view, fp, sigma, pat, is_local)
+        return self._tier3_full(view, fp, sig, is_full)
 
-        def full_all(_):
-            return self._masked_min(view, sig)
+    def _tier3_local(self, view, fp, sigma, pat, is_local):
+        """Tie-group-LOCAL masked min: for a lane whose tie pattern has
+        an enumerable admissible group (<= 24 perms at S=5 for every
+        non-all-tied heavy pattern), enumerate exactly the block
+        permutations of its tied groups composed with the argsort —
+        the COMPLETE admissible set, so the result is bit-identical to
+        the full-table masked min at a fraction of its cost."""
+        B = view.shape[0]
+        S = self.S
+        LCAP = self._p_tab.shape[1]
+        TL = min(B, max(32, B // 16))
+        nsel = jnp.sum(is_local)
+        lsel = jnp.argsort(~is_local).astype(jnp.int32)  # local lanes first
+        lsel = jnp.concatenate([lsel, jnp.full((TL,), B, jnp.int32)])
+        viewp = jnp.concatenate([view, jnp.zeros((1, self.VL), view.dtype)])
+        sigmap = jnp.concatenate(
+            [sigma, jnp.arange(S, dtype=jnp.int32)[None, :]]
+        )
+        patp = jnp.concatenate([pat, jnp.zeros((1,), jnp.int32)])
+        fpp = jnp.concatenate([fp, jnp.zeros((1,), jnp.uint64)])
+        jtl = jnp.arange(TL, dtype=jnp.int32)
 
-        return lax.cond(n_heavy > TCH, full_all, compact_heavy, None)
+        def cond(c):
+            return c[0] * TL < nsel
+
+        def body(c):
+            i, acc = c
+            sel = lax.dynamic_slice(lsel, (i * TL,), (TL,))
+            # guard the block tail: past nsel the lsel order continues
+            # with NON-local lanes, whose pattern tables are incomplete
+            sel = jnp.where(i * TL + jtl < nsel, sel, B)
+            v = viewp[sel]
+            sg = sigmap[sel]
+            tbl = jnp.transpose(self._p_tab[patp[sel]], (1, 0, 2))
+            msk = jnp.transpose(self._p_mask[patp[sel]], (1, 0))
+            # composed[c, b, i] = tbl[c, b, sg[b, i]] — per-lane pattern
+            # perms act on SORTED positions, so compose with the argsort
+            comp = jnp.zeros((LCAP, TL, S), jnp.int32)
+            for u in range(S):
+                comp = comp + jnp.where(
+                    sg[None] == u, tbl[:, :, u][:, :, None], 0
+                )
+            h = jnp.where(msk, self._hash_dyn(v, comp), U64_MAX)
+            return i + 1, acc.at[sel].set(jnp.min(h, axis=0))
+
+        _, fpp = lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), fpp)
+        )
+        return fpp[:B]
+
+    def _tier3_full(self, view, fp, sig, is_full):
+        """Full S!-table masked min for lanes whose admissible group is
+        too large to enumerate locally (the all-tied pattern at S=5:
+        near-init states), drained in adaptive fixed-size blocks."""
+        B = view.shape[0]
+        TF = min(B, max(16, B // 64))
+        nsel = jnp.sum(is_full)
+        fsel = jnp.argsort(~is_full).astype(jnp.int32)
+        fsel = jnp.concatenate([fsel, jnp.full((TF,), B, jnp.int32)])
+        viewp = jnp.concatenate([view, jnp.zeros((1, self.VL), view.dtype)])
+        sigp = jnp.concatenate([sig, jnp.zeros((1, self.S), sig.dtype)])
+        fpp = jnp.concatenate([fp, jnp.zeros((1,), jnp.uint64)])
+        jtf = jnp.arange(TF, dtype=jnp.int32)
+
+        def cond(c):
+            return c[0] * TF < nsel
+
+        def body(c):
+            i, acc = c
+            sel = lax.dynamic_slice(fsel, (i * TF,), (TF,))
+            sel = jnp.where(i * TF + jtf < nsel, sel, B)
+            h = self._masked_min(viewp[sel], sigp[sel])
+            return i + 1, acc.at[sel].set(h)
+
+        _, fpp = lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), fpp)
+        )
+        return fpp[:B]
+
+    # ---------------- raw-keyed canon memoization ----------------
+
+    def raw_fingerprints(self, states):
+        """u64 [B] identity-permutation view hashes — the cheap raw key
+        the canon memo is indexed by (for symmetry=False this IS the
+        canonical fingerprint)."""
+        return self._perm_hash(states[:, : self.VL])
+
+    def fingerprints_memo(self, states, valid, memo):
+        """Memoized canonical fingerprints of a [B, W] state batch.
+
+        ``memo`` is a [MCAP, 2] u64 direct-mapped table (MCAP a power
+        of two): each row holds (raw view hash, canonical fingerprint),
+        empty rows keyed U64_MAX. Returns ``(fps, memo, n_hit)`` with
+        invalid lanes masked to U64_MAX.
+
+        The miss path first dedups equal raw keys WITHIN the chunk
+        (sorted segments, one canon per distinct raw view — duplicate
+        successors inside a chunk are common), then drains the
+        representatives through the tiered canon in fixed-size blocks
+        of an adaptive-trip ``lax.while_loop``: a fully-memoized chunk
+        pays one probe, a cold chunk pays one canon per distinct raw
+        view. Insertion is always-overwrite, with key+value in ONE
+        row-atomic scatter so slot-colliding lanes can never interleave
+        one row's key with another's value; an evicted key simply
+        recomputes on its next miss. Memoization never changes a value
+        — the cached fingerprint was produced by the same tiered canon
+        under the same raw view."""
+        view = states[:, : self.VL]
+        B = view.shape[0]
+        memo = jnp.asarray(memo)  # accept host tables (tests, tools)
+        raw = self._perm_hash(view)
+        if not self.symmetry:
+            return (jnp.where(valid, raw, U64_MAX), memo,
+                    jnp.asarray(0, jnp.int32))
+        MCAP = memo.shape[0]
+        slot = memo_slot(raw, MCAP)
+        row = memo[slot]  # [B, 2]
+        # a raw key equal to the empty sentinel (p = 2^-64) never hits:
+        # it recomputes every time rather than aliasing empty rows
+        hit = valid & eq_u64(row[:, 0], raw) & ne_u64(raw, U64_MAX)
+        need = valid & ~hit
+        n_hit = jnp.sum(hit).astype(jnp.int32)
+
+        # in-chunk dedup: sort the missed raw keys, canon only segment
+        # heads, forward-fill each segment from its head
+        sraw, order = sort_u64_with_idx(jnp.where(need, raw, U64_MAX))
+        is_head = jnp.concatenate(
+            [jnp.ones((1,), bool), ne_u64(sraw[1:], sraw[:-1])]
+        )
+        head = is_head & ne_u64(sraw, U64_MAX)
+        n_rep = jnp.sum(head)
+        CB = min(B, max(64, B // 4))
+        psel = jnp.argsort(~head).astype(jnp.int32)  # head positions first
+        psel = jnp.concatenate([psel, jnp.full((CB,), B, jnp.int32)])
+        orderp = jnp.concatenate([order, jnp.full((1,), B, jnp.int32)])
+        viewp = jnp.concatenate([view, jnp.zeros((1, self.VL), view.dtype)])
+        canon_sorted = jnp.full((B + 1,), U64_MAX, jnp.uint64)
+        jcb = jnp.arange(CB, dtype=jnp.int32)
+
+        def cond(c):
+            return c[0] * CB < n_rep
+
+        def body(c):
+            i, acc = c
+            pos = lax.dynamic_slice(psel, (i * CB,), (CB,))
+            pos = jnp.where(i * CB + jcb < n_rep, pos, B)
+            cfp = self._canon_view(viewp[orderp[pos]])
+            return i + 1, acc.at[pos].set(cfp)
+
+        _, canon_sorted = lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), canon_sorted)
+        )
+        hidx = lax.associative_scan(
+            jnp.maximum,
+            jnp.where(is_head, jnp.arange(B, dtype=jnp.int32), 0),
+        )
+        computed = (
+            jnp.zeros((B,), jnp.uint64)
+            .at[order]
+            .set(canon_sorted[:B][hidx])
+        )
+        fps = jnp.where(hit, row[:, 1], jnp.where(need, computed, U64_MAX))
+        kv = jnp.stack([raw, fps], axis=1)
+        memo = memo.at[jnp.where(need, slot, MCAP)].set(kv, mode="drop")
+        return fps, memo, n_hit

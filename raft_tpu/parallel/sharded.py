@@ -50,7 +50,16 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..checker.lsm import RunLSM, pow2_at_least
+try:  # jax >= 0.5 exports shard_map at top level
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW: dict = {}
+except AttributeError:  # 0.4.x keeps it in experimental; its replication
+    # checker has no rule for while_loop (the memo's blocked canon), so
+    # disable the static check there — it is a check, not a semantic.
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
+
+from ..checker.lsm import CanonMemo, RunLSM, pow2_at_least
 from ..checker.util import (
     GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
 )
@@ -110,6 +119,7 @@ class ShardedBFS:
         max_frontier_cap: int = 1 << 20,
         max_seen_cap: int = 1 << 24,
         max_journal_cap: int = 1 << 24,
+        canon_memo_cap: int = 1 << 21,
     ):
         self.model = model
         self.invariants = tuple(invariants)
@@ -146,6 +156,21 @@ class ShardedBFS:
             jit_kw={"out_shardings": self._sharding},
         )
         self.TOPSZ = self._lsm.TOPSZ
+        # canon memo is PER SHARD ([D, MCAP, 2]): successors are memoized
+        # on the chip that GENERATES them, keyed by the raw view hash,
+        # before the all-to-all routes canonical fps to their owners —
+        # so no memo state ever crosses ICI. Custom canonicalizers
+        # without the memo surface fall back to the unmemoized path.
+        self._use_memo = (
+            canon_memo_cap > 0
+            and hasattr(self.canon, "fingerprints_memo")
+        )
+        self._memo = CanonMemo(
+            canon_memo_cap if self._use_memo else 1,
+            lead_shape=(self.D,),
+            put=lambda h: jax.device_put(h, self._sharding),
+        )
+        self.MCAP = self._memo.MCAP
 
         self._chunk_fn_cache: dict[int, object] = {}
         self._occ_cache: dict[bytes, object] = {}
@@ -185,28 +210,30 @@ class ShardedBFS:
         if fn is None:
             spec = P(AXIS)
             fn = jax.jit(
-                jax.shard_map(
+                _shard_map(
                     self._chunk_step,
                     mesh=self.mesh,
-                    in_specs=(spec,) * 8 + (P(), P(), spec) + (spec,) * n_runs,
-                    out_specs=(spec,) * 7,
+                    in_specs=(spec,) * 9 + (P(), P(), spec) + (spec,) * n_runs,
+                    out_specs=(spec,) * 8,
+                    **_SHARD_MAP_KW,
                 ),
-                # donated: next_buf, jps, jpl, jcand, viol, stats
-                donate_argnums=(2, 3, 4, 5, 6, 7),
+                # donated: next_buf, jps, jpl, jcand, viol, stats, memo
+                donate_argnums=(2, 3, 4, 5, 6, 7, 8),
             )
             self._chunk_fn_cache[n_runs] = fn
         return fn
 
     def _chunk_step(
         self, frontier, fcount, next_buf, jps, jpl, jcand, viol, stats,
-        cursor, occ, base_lgid, *runs,
+        memo, cursor, occ, base_lgid, *runs,
     ):
         """One chunk of the current wave on one chip.
 
         frontier [1,F+1,W]; fcount/base_lgid [1,1]; next_buf [1,F+1,W];
         jps/jpl/jcand [1,JC+1]; viol [1,K]; occ bool[L] (replicated);
-        runs: L sharded [1,lanes] sorted u64; stats [1,S] i64 =
-        [wave new, jcount, cum generated, cum terminal, ovf bits, routed lanes].
+        runs: L sharded [1,lanes] sorted u64; memo [1,MCAP,2] shard-local
+        canon memo; stats [1,S] i64 = [wave new, jcount, cum generated,
+        cum terminal, ovf bits, routed lanes, cum canon memo hits].
         Returns (+ new_run [1,R0]).
         """
         model, D, A, W = self.model, self.D, self.A, self.W
@@ -216,6 +243,7 @@ class ShardedBFS:
         frontier, fcount, base_lgid = frontier[0], fcount[0, 0], base_lgid[0, 0]
         next_buf = next_buf[0]
         jps, jpl, jcand, viol, stats = jps[0], jpl[0], jcand[0], viol[0], stats[0]
+        memo = memo[0]
         runs = [r[0] for r in runs]
 
         # 1. expand `chunk` rows starting at the wave cursor
@@ -245,9 +273,17 @@ class ShardedBFS:
         parent_lgid = base_lgid + cursor + sel // A
         cand = sel % A
 
-        # 3. canonical fingerprints on the compacted lanes
-        fps = self.canon._fingerprints(flatc)
-        fps = jnp.where(selv, fps, U64_MAX)
+        # 3. canonical fingerprints on the compacted lanes — memoized on
+        # the GENERATING chip (raw keys are shard-local; the all-to-all
+        # below only ever moves canonical fingerprints)
+        if self._use_memo:
+            fps, memo, n_memo_hit = self.canon.fingerprints_memo(
+                flatc, selv, memo
+            )
+        else:
+            fps = self.canon._fingerprints(flatc)
+            fps = jnp.where(selv, fps, U64_MAX)
+            n_memo_hit = jnp.asarray(0, jnp.int32)
 
         # 4. route to owner chip = fp mod D: sort by owner, positional slots
         payload = jnp.concatenate(
@@ -341,11 +377,12 @@ class ShardedBFS:
                 stats[3] + term,
                 stats[4] | ovf_bits,
                 stats[5] + n_routed,
+                stats[6] + n_memo_hit,
             ]
         )
         return (
             next_buf[None], jps[None], jpl[None], jcand[None], viol[None],
-            stats[None], new_run[None],
+            stats[None], memo[None], new_run[None],
         )
 
     # ---------------- capacity growth (between waves, host-mediated) ------
@@ -384,9 +421,14 @@ class ShardedBFS:
     # ---------------- checkpoint ----------------
 
     def _ckpt_ident(self) -> str:
+        # hashv=5: k-round 1-WL refinement (ops/symmetry.py) changed the
+        # canonical representative of signature-tied states; the
+        # refinement depth is part of the fingerprint formula. The canon
+        # memo is value-preserving and not part of the identity.
+        wl = getattr(self.canon, "refine_rounds", 1)
         return (
             f"sharded/{self.model.name}/{self.model.p}/W={self.W}"
-            f"/D={self.D}/sym={self.canon.symmetry}/hashv=4"
+            f"/D={self.D}/sym={self.canon.symmetry}/hashv=5/wl={wl}"
             f"/inv={','.join(self.invariants)}"
         )
 
@@ -507,7 +549,7 @@ class ShardedBFS:
             # per-shard generated/terminal/routed cums are not persisted
             # per shard; resume them as deltas from zero and add the saved
             # totals back via the *_base offsets
-            stats_h0 = np.zeros((D, 6), np.int64)
+            stats_h0 = np.zeros((D, 7), np.int64)
             stats_h0[:, 1] = jcounts
             gen_base, term_base, routed_base = gen_prev, terminal, routed_prev
             gen_prev = routed_prev = terminal = 0
@@ -562,7 +604,7 @@ class ShardedBFS:
                     np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
                     self._sharding),
                 "stats": jax.device_put(
-                    np.zeros((D, 6), np.int64), self._sharding),
+                    np.zeros((D, 7), np.int64), self._sharding),
             }
             distinct = int(len(init_d))
             total = int(len(init))  # pre-dedup, matching BFSChecker seeding
@@ -574,6 +616,10 @@ class ShardedBFS:
 
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
+        # fresh per-shard memo per run: a pure cache, but starting empty
+        # keeps consecutive runs of one engine byte-reproducible
+        state["memo"] = self._memo.reset()
+        memo_prev = 0
 
         while fcounts.sum() and violation is None:
             if max_depth is not None and depth >= max_depth:
@@ -610,17 +656,18 @@ class ShardedBFS:
                 occ_dev = self._occ_dev()
                 chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
                 (state["next_buf"], state["jps"], state["jpl"],
-                 state["jcand"], state["viol"], state["stats"], new_run,
+                 state["jcand"], state["viol"], state["stats"],
+                 state["memo"], new_run,
                  ) = chunk_fn(
                     state["frontier"], fc_dev, state["next_buf"],
                     state["jps"], state["jpl"], state["jcand"],
-                    state["viol"], state["stats"], np.int32(cursor),
-                    occ_dev, bl_dev, *self._lsm.runs,
+                    state["viol"], state["stats"], state["memo"],
+                    np.int32(cursor), occ_dev, bl_dev, *self._lsm.runs,
                 )
                 self._lsm.insert(new_run)
                 chunks_done += 1
             stats_h, viol_h = jax.device_get((state["stats"], state["viol"]))
-            stats_h = np.asarray(stats_h)  # [D,6]
+            stats_h = np.asarray(stats_h)  # [D,7]
             viol_h = np.asarray(viol_h)  # [D,K]
             new_d = stats_h[:, 0]
             ovf_bits = int(np.bitwise_or.reduce(stats_h[:, 4]))
@@ -637,6 +684,9 @@ class ShardedBFS:
             terminal = int(stats_h[:, 3].sum())
             wave_routed = int(stats_h[:, 5].sum()) - routed_prev
             routed_prev = int(stats_h[:, 5].sum())
+            memo_hits = int(stats_h[:, 6].sum())
+            wave_memo = memo_hits - memo_prev
+            memo_prev = memo_hits
             if global_new == 0:
                 break
             depth += 1
@@ -688,6 +738,10 @@ class ShardedBFS:
                     "new": global_new,
                     "generated": wave_gen,
                     "dedup_hit_rate": round(1.0 - global_new / max(1, wave_gen), 4),
+                    "canon_memo_hits": wave_memo,
+                    "canon_memo_hit_rate": round(
+                        wave_memo / max(1, wave_gen), 4
+                    ),
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "distinct_per_s": round(distinct / el, 1),
                     "a2a_lanes": wave_routed,

@@ -214,6 +214,7 @@ def main():
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         "budget_s": BUDGET,
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
     })
     for r in pick:
         print(f"=== row {r} ===", flush=True)
@@ -223,6 +224,7 @@ def main():
         except Exception as e:  # record the failure, keep going
             results[f"row{r}"] = {"error": f"{type(e).__name__}: {e}"}
         results[f"row{r}"]["row_wall_s"] = round(time.perf_counter() - t0, 1)
+        results[f"row{r}"]["when"] = results["meta"]["when"]
         print(json.dumps({f"row{r}": results[f"row{r}"]}, indent=1), flush=True)
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)

@@ -53,9 +53,10 @@ def main():
                         symmetry=True, chunk=C,
                         frontier_cap=max(1 << 18, C), seen_cap=1 << 21)
         W = dev.W
-        dev._lsm.seed(np.sort(seen_h.astype(np.uint64)))
-        occ_dev = jnp.asarray(np.asarray(dev._lsm.occ, dtype=bool))
-        runs = tuple(dev._lsm.runs)
+        # round-5 seen design: one sorted U64_MAX-padded run
+        dev._seed_seen(np.sort(seen_h.astype(np.uint64)))
+        occ_dev = dev._occ_one
+        runs = (dev._seen,)
         fh = np.zeros((dev.FCAP + 1, W), np.int32)
         n = min(len(frontier_h), dev.FCAP)
         fh[:n] = frontier_h[:n]
@@ -67,8 +68,9 @@ def main():
             jc = jnp.zeros((dev.JCAP + 1,), jnp.int32)
             viol = jnp.full((max(1, len(dev.invariants)),),
                             np.int32(2**31 - 1), jnp.int32)
-            stats = jnp.zeros((5,), jnp.int64)
-            return [frontier, nb, jp, jc, viol, stats, np.int32(0),
+            stats = jnp.zeros((6,), jnp.int64)
+            memo = dev._memo.reset()
+            return [frontier, nb, jp, jc, viol, stats, memo, np.int32(0),
                     np.int32(min(n, C)), np.int32(0), occ_dev,
                     jnp.asarray(True), *runs]
 

@@ -1,0 +1,45 @@
+"""CI smoke for the stage profiler (checker/profile.py).
+
+One tiny fused chunk on CPU with stage timers enabled: every stage the
+profiler DECLARES must actually report, so stage accounting cannot
+silently rot when the chunk pipeline changes shape (the round-5 wave
+fusion broke the profiler exactly that way — it kept addressing the
+retired per-chunk LSM). Timings themselves are not asserted: CPU CI
+noise makes any threshold flaky; presence and well-formedness are the
+contract.
+"""
+
+from raft_tpu.checker.profile import DECLARED_STAGES, profile_stages, render
+from raft_tpu.models.raft import RaftModel, RaftParams
+
+
+def test_profile_reports_every_declared_stage():
+    p = RaftParams(3, 3, max_elections=2, max_restarts=0, msg_slots=24)
+    model = RaftModel(p)
+    inv = tuple(list(model.invariants)[:1])
+    prof = profile_stages(
+        model, invariants=inv, chunk=128, frontier_cap=1 << 12,
+        seen_cap=1 << 14, warm_depth=4, reps=1,
+    )
+
+    missing = [k for k in DECLARED_STAGES if k not in prof["stages_s"]]
+    assert not missing, f"profiler dropped declared stages: {missing}"
+    for k in DECLARED_STAGES:
+        v = prof["stages_s"][k]
+        assert isinstance(v, float) and v >= 0.0, (k, v)
+
+    # the memoized canon stages must really time (not report the 0.0
+    # not-applicable placeholder) on a standard symmetric model
+    assert prof["stages_s"]["canon"] > 0.0
+    assert prof["stages_s"]["canon_memo_hit"] > 0.0
+    # raft3 (S=3) has no pruned tier path, so the tier-3 stage reports
+    # its placeholder — present, exactly 0.0
+    assert prof["stages_s"]["canon_tier3_local"] == 0.0
+
+    pw = prof["per_wave_s"]
+    assert 0.0 <= pw["canon_share_of_stage_sum"] <= 1.0
+    assert pw["stage_sum_per_chunk"] > 0.0
+
+    txt = render(prof)
+    for k in DECLARED_STAGES:
+        assert k in txt, f"render() dropped stage {k}"

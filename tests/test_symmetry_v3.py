@@ -1,10 +1,18 @@
-"""Property tests for the v3 canonical fingerprint (ops/symmetry.py):
-sort-free multiset bag hashing + signature-pruned permutation min.
+"""Property tests for the canonical fingerprint (ops/symmetry.py):
+sort-free multiset bag hashing + signature-pruned permutation min, plus
+the v5 additions — k-round 1-WL signature refinement, the tie-group-
+local tier 3, and the raw-keyed canon memo.
 
 The correctness contract (module docstring there):
-  - the per-server signature is permutation-EQUIVARIANT,
-  - the fast signature-argsort path is bit-identical to the brute-force
-    masked min over the full S! table (mode="full"),
+  - the per-server signature is permutation-EQUIVARIANT at every
+    refinement depth,
+  - the fast tiered path is bit-identical to the brute-force masked min
+    over the full S! table (mode="full") at the SAME refinement depth —
+    for every tier route (argsort-only, swap products, tie-group-local
+    blocks, full-table drain),
+  - memoization is value-preserving: a memo hit returns exactly the
+    cold-canon fingerprint, under any table size (including constant
+    eviction at tiny capacities),
   - fingerprints are orbit-invariant and separate orbits exactly like
     the oracle's canonical view (TLC's SYMMETRY semantics,
     ``Raft.tla:116``).
@@ -50,15 +58,16 @@ def pull3():
 CASES = {"raft3": raft3, "raft5": raft5, "pull3": pull3}
 
 
-def canon_pair(model):
-    auto = Canonicalizer.for_model(model, symmetry=True)
+def canon_pair(model, refine_rounds: int = 3):
+    auto = Canonicalizer.for_model(model, symmetry=True,
+                                   refine_rounds=refine_rounds)
     full = Canonicalizer(
         model.layout, model.packer,
         msg_server_fields=getattr(model, "msg_server_fields",
                                   ("msource", "mdest")),
         msg_server_nil_fields=getattr(model, "msg_server_nil_fields", ()),
         msg_perm_spec=getattr(model, "msg_perm_spec", None),
-        symmetry=True, mode="full",
+        symmetry=True, mode="full", refine_rounds=refine_rounds,
     )
     return auto, full
 
@@ -83,9 +92,11 @@ def test_auto_equals_bruteforce(name):
 @pytest.mark.parametrize("name", ["raft3", "raft5"])
 def test_auto_equals_bruteforce_tie_heavy(name):
     # a batch of replicated Init states is 100% signature-tied with
-    # S-sized tie groups, forcing the lax.cond full-table branch
-    # (heavy lanes > B//8); interleave with distinct states so every
-    # tier lands in one batch
+    # S-sized (all-tied) groups — the full-S!-table drain — while the
+    # reachable states mix in argsort-only, swap-product and tie-group-
+    # local lanes; the adaptive blocked tier 3 must stay bit-identical
+    # no matter how many heavy lanes a chunk carries (the retired
+    # static-budget design fell off a whole-batch lax.cond cliff here)
     model, _oracle, _states, vecs = states_of(name, depth=3, cap=40)
     reps = np.repeat(model.init_states(), 200, axis=0)
     batch = np.concatenate([reps, vecs, reps], axis=0)
@@ -93,6 +104,58 @@ def test_auto_equals_bruteforce_tie_heavy(name):
     fa = np.asarray(auto.fingerprints(batch))
     fb = np.asarray(full.fingerprints(batch))
     assert np.array_equal(fa, fb)
+
+
+@pytest.mark.parametrize("rounds", [1, 2, 3])
+def test_refinement_rounds_bit_identical_to_bruteforce(rounds):
+    # the k-round 1-WL refinement changes WHICH permutations are
+    # admissible (and so the fingerprint VALUES of tied states), but at
+    # every depth the pruned tiered path must equal the full-table
+    # masked min computed at the SAME depth
+    model, _oracle, _states, vecs = states_of("raft5", depth=3, cap=60)
+    reps = np.repeat(model.init_states(), 50, axis=0)
+    batch = np.concatenate([reps, vecs], axis=0)
+    auto, full = canon_pair(model, refine_rounds=rounds)
+    fa = np.asarray(auto.fingerprints(batch))
+    fb = np.asarray(full.fingerprints(batch))
+    assert np.array_equal(fa, fb)
+    assert not np.any(fa == U64_MAX)
+
+
+def test_refinement_depth_preserves_partition():
+    # deeper refinement only shrinks tie groups WITHIN an orbit: the
+    # induced equality partition over a reachable sample must not move
+    # (values may — the admissible-set minimum changes representative)
+    model, _oracle, _states, vecs = states_of("raft5", depth=3, cap=120)
+    parts = []
+    for rounds in (1, 2, 3):
+        auto, _ = canon_pair(model, refine_rounds=rounds)
+        fps = np.asarray(auto.fingerprints(vecs)).tolist()
+        first = {}
+        parts.append([first.setdefault(fp, i) for i, fp in enumerate(fps)])
+    assert parts[0] == parts[1] == parts[2]
+
+
+def test_tie_group_local_lanes_exercised_and_bit_identical():
+    # the tie-group-local tier must actually fire (lanes whose largest
+    # tie group is >= 3 but not all-tied) alongside full-table lanes,
+    # and both routes must match brute force lane-for-lane
+    model, _oracle, _states, vecs = states_of("raft5", depth=2, cap=120)
+    reps = np.repeat(model.init_states(), 30, axis=0)
+    batch = np.concatenate([vecs, reps], axis=0).astype(np.int32)
+    auto, full = canon_pair(model)
+    view = batch[:, : auto.VL]
+    sig = auto._signatures(view)
+    _fp, _sigma, _pat, is_local, is_full = auto._tier_pre(view, sig)
+    is_local = np.asarray(is_local)
+    is_full = np.asarray(is_full)
+    assert is_local.sum() > 0, "no tie-group-local lanes in the sample"
+    assert is_full.sum() > 0, "no full-table lanes in the sample"
+    fa = np.asarray(auto.fingerprints(batch))
+    fb = np.asarray(full.fingerprints(batch))
+    assert np.array_equal(fa, fb)
+    # the local route in particular (the new code path) is bit-identical
+    assert np.array_equal(fa[is_local], fb[is_local])
 
 
 @pytest.mark.parametrize("name", list(CASES))
@@ -175,6 +238,59 @@ def test_bag_multiset_hash_slot_order_free():
     f1 = np.asarray(auto.fingerprints(vec))
     f2 = np.asarray(auto.fingerprints(swapped))
     assert np.array_equal(f1, f2)
+
+
+def _fresh_memo(cap):
+    return np.full((cap, 2), np.uint64(U64_MAX))
+
+
+@pytest.mark.parametrize("name", ["raft3", "raft5"])
+def test_memo_cold_equals_plain(name):
+    # a cold (all-empty) memo pass computes every fingerprint through
+    # the same tiered canon — bit-identical to the unmemoized entry
+    model, _oracle, _states, vecs = states_of(name, depth=3, cap=80)
+    reps = np.repeat(model.init_states(), 40, axis=0)
+    batch = np.concatenate([vecs, reps, vecs], axis=0).astype(np.int32)
+    auto, _ = canon_pair(model)
+    valid = np.ones(len(batch), dtype=bool)
+    plain = np.asarray(auto.fingerprints(batch))
+    cold, memo1, n_hit = auto.fingerprints_memo(
+        batch, valid, _fresh_memo(1 << 12))
+    assert np.array_equal(np.asarray(cold), plain)
+    assert int(n_hit) == 0
+
+    # warm pass over the same batch: hits must return the SAME values
+    warm, _memo2, n_hit2 = auto.fingerprints_memo(batch, valid, memo1)
+    assert np.array_equal(np.asarray(warm), plain)
+    assert int(n_hit2) > 0
+
+
+def test_memo_invalid_lanes_masked():
+    model, _oracle, _states, vecs = states_of("raft3", depth=3, cap=60)
+    auto, _ = canon_pair(model)
+    valid = np.arange(len(vecs)) % 3 != 0
+    fps, _memo, _n = auto.fingerprints_memo(
+        vecs.astype(np.int32), valid, _fresh_memo(1 << 10))
+    fps = np.asarray(fps)
+    assert np.all(fps[~valid] == U64_MAX)
+    plain = np.asarray(auto.fingerprints(vecs))
+    assert np.array_equal(fps[valid], plain[valid])
+
+
+def test_memo_correct_across_eviction():
+    # a 2-slot table under a few hundred distinct keys evicts on nearly
+    # every insert; values must stay exactly the cold canon regardless —
+    # eviction only costs recomputation, never correctness
+    model, _oracle, _states, vecs = states_of("raft5", depth=3, cap=100)
+    reps = np.repeat(model.init_states(), 20, axis=0)
+    batch = np.concatenate([vecs, reps], axis=0).astype(np.int32)
+    auto, _ = canon_pair(model)
+    valid = np.ones(len(batch), dtype=bool)
+    plain = np.asarray(auto.fingerprints(batch))
+    memo = _fresh_memo(2)
+    for _ in range(3):  # repeated passes churn the tiny table
+        fps, memo, _n = auto.fingerprints_memo(batch, valid, memo)
+        assert np.array_equal(np.asarray(fps), plain)
 
 
 def test_seeded_family_differs():
