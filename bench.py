@@ -149,10 +149,18 @@ def main():
     from raft_tpu.models.registry import build_from_cfg
     from raft_tpu.checker.device_bfs import DeviceBFS
     from raft_tpu.checker.parity import parity_gate
+    from raft_tpu.obs import Telemetry
 
     cfg = parse_cfg(CFG)
     setup = build_from_cfg(cfg, msg_slots=32)
     model, invs = setup.model, setup.invariants
+
+    # live telemetry for the headline run: the JSONL stream is the
+    # benchmark's provenance record (manifest = engine geometry + device;
+    # wave events = the trajectory below), schema-checked after the run
+    metrics_path = os.environ.get(
+        "BENCH_METRICS_OUT", "/tmp/bench_metrics.jsonl")
+    tel = Telemetry(metrics_path=metrics_path)
 
     # 0. build at FINAL capacities (growth would retrace the chunk
     # program mid-run: ~100 s each through the remote-compile service)
@@ -164,18 +172,25 @@ def main():
         max_frontier_cap=1 << 22, max_seen_cap=1 << 25,
         max_journal_cap=1 << 25,
     )
-    big.precompile()
+    big.precompile(telemetry=tel)
     precompile_s = time.perf_counter() - t0
     floor_s = measure_floor()
 
     # 1. deep run: sustained rate under the time budget (the headline),
     # timed in a process region that compiles nothing
-    deep = big.run(time_budget_s=budget, collect_metrics=True)
-    waves = deep.metrics or []
+    deep = big.run(time_budget_s=budget, telemetry=tel)
+    manifest = next(
+        (e for e in tel.events if e["event"] == "manifest"), {})
+    waves = tel.wave_events()
     trajectory = [
         {k: m[k] for k in ("depth", "new", "wave_s", "distinct_per_s")}
         for m in waves[-10:]
     ]
+    deep_summary = tel.last_summary or {}
+    tel.close()
+    from scripts.check_metrics_schema import validate_file
+
+    _, metrics_problems = validate_file(metrics_path)
 
     # 2. parity gate at a second chunk geometry (defense against the
     # batch-geometry miscompile class, ops/bag.py)
@@ -267,6 +282,19 @@ def main():
             "dispatch_floor_ms": round(floor_s * 1e3, 1),
             "precompile_s": round(precompile_s, 1),
             "wave_trajectory": trajectory,
+            # provenance from the telemetry manifest/summary events
+            "manifest": {
+                k: manifest.get(k)
+                for k in ("ident", "hashv", "canon_memo_cap", "device",
+                          "platform", "chunk")
+            },
+            "exit_cause": deep_summary.get("exit_cause"),
+            "canon_memo_hit_rate": deep_summary.get("canon_memo_hit_rate"),
+            "metrics_file": {
+                "path": metrics_path,
+                "schema_ok": not metrics_problems,
+                "problems": metrics_problems[:5],
+            },
             "same_depth_cmp": {
                 "depth": cmp_depth,
                 "distinct": tpu_cmp.distinct,

@@ -110,6 +110,47 @@ def main(argv=None):
         "v2) to warnings and apply the obvious repair",
     )
     ap.add_argument(
+        "--progress",
+        nargs="?",
+        const=10.0,
+        type=float,
+        default=None,
+        metavar="SECS",
+        help="TLC-style progress line on stderr (throttled to one line "
+        "per SECS seconds, default 10; stall warnings print immediately)",
+    )
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="write the live telemetry event stream (manifest/wave/stall/"
+        "summary, one JSON object per line) to PATH; validate with "
+        "scripts/check_metrics_schema.py",
+    )
+    ap.add_argument(
+        "--metrics-every",
+        type=int,
+        default=1,
+        metavar="N",
+        help="write every Nth wave event (the final wave always flushes, "
+        "so the stream stays count-accurate)",
+    )
+    ap.add_argument(
+        "--trace-dir",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace: each BFS wave is an xprof "
+        "step (StepTraceAnnotation) and precompile/seen_merge/checkpoint "
+        "are named spans",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="print the run's summary event as the last stdout line "
+        "(machine-readable; everything else non-result already goes to "
+        "stderr); BFS checkers only",
+    )
+    ap.add_argument(
         "--platform",
         default=os.environ.get("RAFT_TPU_PLATFORM", "auto"),
         choices=["auto", "cpu", "tpu", "axon"],
@@ -143,10 +184,14 @@ def main(argv=None):
         return 64
     symmetry = setup.symmetry and not args.no_symmetry
     props = tuple(cfg.properties)
+    # non-result chatter (banner, config warnings, audit diagnostics,
+    # progress) goes to stderr: stdout carries only the result lines —
+    # and, under --json, the summary event as its last line
     print(
         f"spec={setup.model.name} servers={setup.server_names} "
         f"values={setup.value_names} invariants={list(setup.invariants)} "
-        f"properties={list(props)} symmetry={symmetry} checker={args.checker}"
+        f"properties={list(props)} symmetry={symmetry} checker={args.checker}",
+        file=sys.stderr,
     )
     if props:
         # PROPERTY lines are temporal formulas; refuse configurations this
@@ -215,7 +260,7 @@ def main(argv=None):
             setup.model, invariants=setup.invariants, symmetry=symmetry,
             depth=args.collision_audit, chunk=args.chunk, **cli_caps,
         )
-        print(audit)
+        print(audit, file=sys.stderr)
         if not audit.ok:
             print(
                 "error: fingerprint-collision audit failed — counts differ "
@@ -360,6 +405,31 @@ def main(argv=None):
             symmetry=symmetry,
             chunk=args.chunk,
         )
+    tel = None
+    if (
+        args.progress is not None or args.metrics_out is not None
+        or args.trace_dir is not None or args.json
+    ):
+        from .obs import Telemetry
+
+        tel = Telemetry(
+            metrics_path=args.metrics_out,
+            every=args.metrics_every,
+            progress_every=args.progress,
+            trace_dir=args.trace_dir,
+        )
+
+    def _finish(rc: int) -> int:
+        """Close telemetry and, under --json, make the summary event the
+        last stdout line on EVERY BFS-checker return path."""
+        if tel is not None:
+            tel.close()
+            if args.json and tel.last_summary is not None:
+                import json
+
+                print(json.dumps(tel.last_summary))
+        return rc
+
     run_kw = {}
     if args.checker in ("tpu", "sharded"):
         run_kw = dict(
@@ -371,6 +441,7 @@ def main(argv=None):
         max_depth=args.max_depth,
         verbose=args.verbose,
         time_budget_s=args.time_budget,
+        telemetry=tel,
         **run_kw,
     )
     viol_name = (
@@ -393,7 +464,7 @@ def main(argv=None):
                 print(format_trace_tlc(res.trace, setup, viol_name))
             else:
                 print(format_trace(res.trace, setup))
-        return 2
+        return _finish(2)
     print("no invariant violations")
 
     if props:
@@ -429,9 +500,9 @@ def main(argv=None):
                 if v.cycle:
                     print("-- loop (repeats forever) --")
                     print(format_trace(v.cycle, setup))
-            return 2
+            return _finish(2)
         print("no temporal property violations")
-    return 0
+    return _finish(0)
 
 
 if __name__ == "__main__":

@@ -15,12 +15,15 @@ initial state (SURVEY.md §5.1).
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass, field
 
 import jax
 import numpy as np
 
+from ..obs import NULL_TELEMETRY
+from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
 
@@ -92,11 +95,15 @@ class BFSChecker:
         max_depth: int | None = None,
         verbose: bool = False,
         time_budget_s: float | None = None,
+        collect_metrics: bool = False,
+        telemetry=None,
     ) -> CheckResult:
         model = self.model
         B = self.chunk
         t0 = time.perf_counter()
         exhausted = True
+        exit_cause = None
+        tel = telemetry if telemetry is not None else NULL_TELEMETRY
 
         init = model.init_states()
         n0 = len(init)
@@ -121,16 +128,21 @@ class BFSChecker:
         if viol is not None:
             violation = viol
 
+        tel.open_run(self._telemetry_manifest())
+        metrics: list[dict] | None = [] if collect_metrics else None
         depth = 0
         base_gid = 0  # global id of first state in current frontier
         next_gid = distinct
         while len(frontier) and violation is None:
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
+                exit_cause = "max_depth"
                 break
             if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 exhausted = False
+                exit_cause = "time_budget"
                 break
+            tw = time.perf_counter()
             new_states: list[np.ndarray] = []
             new_parents: list[np.ndarray] = []
             new_cands: list[np.ndarray] = []
@@ -140,67 +152,119 @@ class BFSChecker:
             wave_fps = np.empty(0, dtype=np.uint64)
             n_cand_total = 0
             has_succ = np.zeros(len(frontier), dtype=bool)
-            for off in range(0, len(frontier), B):
-                chunk_states = frontier[off : off + B]
-                nb = len(chunk_states)
-                if nb < B:  # pad to the compiled batch shape
-                    pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
-                    chunk_states = np.concatenate([chunk_states, pad], axis=0)
-                succs, valid, _rank, ovf = self._expand(chunk_states)
-                valid = np.array(jax.device_get(valid))
-                valid[nb:] = False
-                if np.any(valid & np.asarray(jax.device_get(ovf))):
-                    raise OverflowError(
-                        "message-slot overflow: re-run with a larger msg_slots"
-                    )
-                flat = succs.reshape(-1, model.layout.W)
-                fps = np.array(jax.device_get(self._fps(flat)), dtype=np.uint64)
-                fps[~valid.reshape(-1)] = U64_MAX
-                n_cand_total += int(valid.sum())
-                has_succ[off : off + nb] = valid[:nb].any(axis=1)
+            with tel.wave_annotation(depth + 1):
+                for off in range(0, len(frontier), B):
+                    chunk_states = frontier[off : off + B]
+                    nb = len(chunk_states)
+                    if nb < B:  # pad to the compiled batch shape
+                        pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
+                        chunk_states = np.concatenate([chunk_states, pad], axis=0)
+                    succs, valid, _rank, ovf = self._expand(chunk_states)
+                    valid = np.array(jax.device_get(valid))
+                    valid[nb:] = False
+                    if np.any(valid & np.asarray(jax.device_get(ovf))):
+                        raise OverflowError(
+                            "message-slot overflow: re-run with a larger msg_slots"
+                        )
+                    flat = succs.reshape(-1, model.layout.W)
+                    fps = np.array(jax.device_get(self._fps(flat)), dtype=np.uint64)
+                    fps[~valid.reshape(-1)] = U64_MAX
+                    n_cand_total += int(valid.sum())
+                    has_succ[off : off + nb] = valid[:nb].any(axis=1)
 
-                # first-occurrence-in-order selection of unseen fingerprints
-                new_mask = fps != U64_MAX
-                new_mask &= ~_in_sorted(seen, fps)
-                new_mask &= ~_in_sorted(wave_fps, fps)
-                # in-chunk dedup, keeping first occurrence
-                _, first_idx = np.unique(fps, return_index=True)
-                first = np.zeros(len(fps), dtype=bool)
-                first[first_idx] = True
-                new_mask &= first
-                idx = np.nonzero(new_mask)[0]
-                if len(idx):
-                    sel = np.asarray(jax.device_get(flat[idx]))
-                    new_states.append(sel)
-                    new_parents.append(base_gid + off + idx // model.A)
-                    new_cands.append((idx % model.A).astype(np.int32))
-                    wave_fps = np.sort(np.concatenate([wave_fps, fps[idx]]))
+                    # first-occurrence-in-order selection of unseen fingerprints
+                    new_mask = fps != U64_MAX
+                    new_mask &= ~_in_sorted(seen, fps)
+                    new_mask &= ~_in_sorted(wave_fps, fps)
+                    # in-chunk dedup, keeping first occurrence
+                    _, first_idx = np.unique(fps, return_index=True)
+                    first = np.zeros(len(fps), dtype=bool)
+                    first[first_idx] = True
+                    new_mask &= first
+                    idx = np.nonzero(new_mask)[0]
+                    if len(idx):
+                        sel = np.asarray(jax.device_get(flat[idx]))
+                        new_states.append(sel)
+                        new_parents.append(base_gid + off + idx // model.A)
+                        new_cands.append((idx % model.A).astype(np.int32))
+                        wave_fps = np.sort(np.concatenate([wave_fps, fps[idx]]))
 
             total += n_cand_total
             terminal += int((~has_succ).sum())
             if not new_states:
+                exit_cause = "exhausted"
                 break
             wave_states = np.concatenate(new_states, axis=0)
             wave_parents = np.concatenate(new_parents)
             wave_cands = np.concatenate(new_cands)
             self._parents.append(wave_parents)
             self._cands.append(wave_cands)
-            seen = _merge_sorted(seen, wave_fps)
+            with tel.annotate("seen_merge"):
+                seen = _merge_sorted(seen, wave_fps)
             depth += 1
             depth_counts.append(len(wave_states))
             violation = self._check_invariants(wave_states, next_gid, depth)
             base_gid = next_gid
             next_gid += len(wave_states)
             distinct += len(wave_states)
+            prev_frontier = len(frontier)
             frontier = wave_states
-            if verbose:
+            if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
-                print(
-                    f"depth {depth}: frontier {len(wave_states)}, distinct {distinct}, "
-                    f"total {total}, {distinct/el:.0f} distinct/s"
-                )
+                wm = {
+                    "depth": depth,
+                    "frontier": prev_frontier,
+                    "new": len(wave_states),
+                    "distinct": distinct,
+                    "generated": n_cand_total,
+                    "generated_total": total,
+                    "terminal": terminal,
+                    "dedup_hit_rate": round(
+                        1.0 - len(wave_states) / max(1, n_cand_total), 4),
+                    # the host engine has no canon memo; the declared keys
+                    # still appear so one consumer reads all three engines
+                    "canon_memo_hits": 0,
+                    "canon_memo_hit_rate": 0.0,
+                    "overflow_bits": 0,
+                    "lsm_runs": 1,
+                    "lsm_lanes": int(len(seen)),
+                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "elapsed_s": round(el, 3),
+                    "distinct_per_s": round(distinct / el, 1),
+                }
+                tel.wave(wm)
+                if metrics is not None:
+                    metrics.append(wm)
+                if verbose:
+                    print(
+                        f"depth {depth}: frontier {len(wave_states)}, "
+                        f"distinct {distinct}, total {total}, "
+                        f"{distinct/el:.0f} distinct/s",
+                        file=sys.stderr,
+                    )
 
         dt = time.perf_counter() - t0
+        if violation is not None:
+            exit_cause = "violation"
+        elif exit_cause is None:
+            exit_cause = "exhausted"
+        tel.close_run({
+            "engine": "host",
+            "ident": self._ckpt_ident(),
+            "exit_cause": exit_cause,
+            "violation": violation.invariant if violation else None,
+            "distinct": distinct,
+            "total": total,
+            "depth": depth,
+            "terminal": terminal,
+            "seconds": round(dt, 3),
+            "distinct_per_s": round(distinct / dt, 1) if dt > 0 else 0.0,
+            "exhausted": exhausted and violation is None,
+            "peak_frontier_cap": int(max(depth_counts)),
+            "peak_journal_cap": int(next_gid - len(self._init_distinct)),
+            "seen_lanes": int(len(seen)),
+            "canon_memo_hit_rate": 0.0,
+        })
         trace = self.reconstruct_trace(violation) if violation else None
         return CheckResult(
             distinct=distinct,
@@ -213,7 +277,43 @@ class BFSChecker:
             states_per_sec=distinct / dt if dt > 0 else 0.0,
             exhausted=exhausted and violation is None,
             trace=trace,
+            metrics=metrics,
         )
+
+    def _ckpt_ident(self) -> str:
+        """Same identity grammar as the device engines (hashv marks the
+        fingerprint formula revision; see DeviceBFS._ckpt_ident)."""
+        wl = getattr(self.canon, "refine_rounds", 1)
+        return (
+            f"host/{self.model.name}/{self.model.p}/W={self.model.layout.W}"
+            f"/sym={self.canon.symmetry}/hashv=5/wl={wl}"
+            f"/inv={','.join(self.invariants)}"
+        )
+
+    def _telemetry_manifest(self) -> dict:
+        """Run-provenance fields of the telemetry manifest event. The
+        host engine's arrays are unbounded python/numpy buffers, so the
+        capacity fields are 0 (= not capacity-limited)."""
+        dev = jax.devices()[0]
+        ident = self._ckpt_ident()
+        return {
+            "engine": "host",
+            "ident": ident,
+            "hashv": hashv_of(ident),
+            "model": self.model.name,
+            "platform": dev.platform,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "device_count": 1,
+            "chunk": self.chunk,
+            "frontier_cap": 0,
+            "journal_cap": 0,
+            "max_seen_cap": 0,
+            "valid_cap": 0,
+            "canon_memo_cap": 0,
+            "symmetry": bool(self.canon.symmetry),
+            "invariants": list(self.invariants),
+            "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
 
     def _check_invariants(self, states: np.ndarray, base_gid: int, depth: int):
         """Batched invariant evaluation; returns the first (in exploration

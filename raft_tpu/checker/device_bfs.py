@@ -39,6 +39,7 @@ not errors, reference README.md:7).
 
 from __future__ import annotations
 
+import sys
 import time
 
 import jax
@@ -46,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..obs import NULL_TELEMETRY
+from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
@@ -163,6 +166,7 @@ class DeviceBFS:
         self._jparent = None
         self._jcand = None
         self._jcount = 0
+        self._tel = NULL_TELEMETRY  # active only inside run(telemetry=...)
 
     # ---------------- seen-set adapters ----------------
 
@@ -432,7 +436,7 @@ class DeviceBFS:
 
     # ---------------- precompile ----------------
 
-    def precompile(self) -> None:
+    def precompile(self, telemetry=None) -> None:
         """Compile (and execute once, on zero/sentinel buffers) every
         device program a run at the CURRENT capacities can need: the
         chunk program and the full LSM merge ladder. Mid-run compiles
@@ -442,7 +446,13 @@ class DeviceBFS:
         which the persistent compile cache turns into ~2 s disk hits in
         later processes — the timed region never compiles. Growth steps
         still retrace, so benchmark callers should start at their final
-        capacities."""
+        capacities. ``telemetry``: a --trace-dir run brackets the whole
+        warmup in a named "precompile" span."""
+        tel = telemetry if telemetry is not None else NULL_TELEMETRY
+        with tel.annotate("precompile"):
+            self._precompile_programs()
+
+    def _precompile_programs(self) -> None:
         W = self.W
         K = self._wave_geom()
         frontier = jnp.zeros((self.FCAP + 1, W), jnp.int32)
@@ -518,11 +528,18 @@ class DeviceBFS:
         checkpoint_path: str | None = None,
         checkpoint_every_s: float = 300.0,
         resume: str | None = None,
+        telemetry=None,
     ) -> CheckResult:
         model = self.model
         C, W = self.chunk, self.W
         t0 = time.perf_counter()
         exhausted = True
+        exit_cause = None
+        # telemetry consumes the SAME once-per-wave host snapshot the
+        # loop already fetches (stats_h below), so an instrumented run
+        # adds no device syncs and stays bit-identical (tests/test_obs.py)
+        tel = telemetry if telemetry is not None else NULL_TELEMETRY
+        self._tel = tel
 
         init = model.init_states()
         init_fps = np.asarray(
@@ -614,15 +631,18 @@ class DeviceBFS:
         memo = self._memo.reset()
         memo_prev = 0
 
+        tel.open_run(self._telemetry_manifest())
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
 
         while fcount and violation is None:
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
+                exit_cause = "max_depth"
                 break
             if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 exhausted = False
+                exit_cause = "time_budget"
                 break
             # capacity guard: the top-level absorb truncates at TOPSZ
             # lanes, which is only sound while every real fingerprint is
@@ -661,17 +681,19 @@ class DeviceBFS:
             # binary-counter ladder, merged into the single seen run
             # below AFTER the overflow check (so an aborted wave leaves
             # the seen-set untouched and the run trivially resumable)
-            out = self._wave_fn(
-                frontier, next_buf, jparent, jcand, viol, stats, memo,
-                np.int32(fcount), np.int32(base_gid),
-                self._occ_one, self._seen,
-            )
-            next_buf, jparent, jcand, viol, stats, memo = out[:6]
-            ladder = out[6:]
-            # one host round-trip per wave: stats and the invariant fold
-            # fetched together (two device_gets double the tunnel RTT on
-            # small configs, where per-wave latency dominates)
-            stats_h, viol_h = jax.device_get((stats, viol))
+            with tel.wave_annotation(depth + 1):
+                out = self._wave_fn(
+                    frontier, next_buf, jparent, jcand, viol, stats, memo,
+                    np.int32(fcount), np.int32(base_gid),
+                    self._occ_one, self._seen,
+                )
+                next_buf, jparent, jcand, viol, stats, memo = out[:6]
+                ladder = out[6:]
+                # one host round-trip per wave: stats and the invariant
+                # fold fetched together (two device_gets double the
+                # tunnel RTT on small configs, where per-wave latency
+                # dominates) — and telemetry rides this same snapshot
+                stats_h, viol_h = jax.device_get((stats, viol))
             stats_h = np.asarray(stats_h)
             viol_h = np.asarray(viol_h)
             ncount = int(stats_h[0])
@@ -702,12 +724,14 @@ class DeviceBFS:
             gen_prev = n_gen
             terminal = int(stats_h[3])
             if ncount == 0:
+                exit_cause = "exhausted"
                 break
             scount += ncount
             # fold the wave ladder into the single seen run (device-side
             # sort-concat; the merge-program signature set is warmed by
             # precompile)
-            self._merge_seen(ladder, scount)
+            with tel.annotate("seen_merge"):
+                self._merge_seen(ladder, scount)
             depth += 1
             distinct += ncount
             depth_counts.append(ncount)
@@ -741,29 +765,36 @@ class DeviceBFS:
             memo_hits = int(stats_h[5])
             wave_memo = memo_hits - memo_prev
             memo_prev = memo_hits
-            if metrics is not None or verbose:
+            if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
                     "depth": depth,
                     "frontier": prev_fcount,
                     "new": ncount,
+                    "distinct": distinct,
                     "generated": wave_gen,
+                    "generated_total": total,
+                    "terminal": terminal,
                     "dedup_hit_rate": round(1.0 - ncount / max(1, wave_gen), 4),
                     "canon_memo_hits": wave_memo,
                     "canon_memo_hit_rate": round(
                         wave_memo / max(1, wave_gen), 4
                     ),
+                    "overflow_bits": ovf_bits,
                     "wave_s": round(time.perf_counter() - tw, 3),
+                    "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
                     "lsm_runs": 1,
                     "lsm_lanes": int(self._seen.shape[0]),
                 }
+                tel.wave(wm)
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
                     print(
                         f"depth {depth}: frontier {ncount}, distinct {distinct}, "
-                        f"total {total}, {distinct/el:.0f} distinct/s"
+                        f"total {total}, {distinct/el:.0f} distinct/s",
+                        file=sys.stderr,
                     )
 
         if checkpoint_path is not None and violation is None and not exhausted:
@@ -786,6 +817,27 @@ class DeviceBFS:
         self._memo.table = memo
 
         dt = time.perf_counter() - t0
+        if violation is not None:
+            exit_cause = "violation"
+        elif exit_cause is None:
+            exit_cause = "exhausted"
+        tel.close_run({
+            "engine": "device",
+            "ident": self._ckpt_ident(),
+            "exit_cause": exit_cause,
+            "violation": violation.invariant if violation else None,
+            "distinct": distinct,
+            "total": total,
+            "depth": depth,
+            "terminal": terminal,
+            "seconds": round(dt, 3),
+            "distinct_per_s": round(distinct / dt, 1) if dt > 0 else 0.0,
+            "exhausted": exhausted and violation is None,
+            "peak_frontier_cap": self.FCAP,
+            "peak_journal_cap": self.JCAP,
+            "seen_lanes": int(self._seen.shape[0]),
+            "canon_memo_hit_rate": round(memo_prev / max(1, gen_prev), 4),
+        })
         trace = self.reconstruct_trace(violation) if violation else None
         res = CheckResult(
             distinct=distinct,
@@ -801,6 +853,30 @@ class DeviceBFS:
             metrics=metrics,
         )
         return res
+
+    def _telemetry_manifest(self) -> dict:
+        """Run-provenance fields of the telemetry manifest event (all
+        MANIFEST_KEYS except the auto-added "event")."""
+        dev = jax.devices()[0]
+        ident = self._ckpt_ident()
+        return {
+            "engine": "device",
+            "ident": ident,
+            "hashv": hashv_of(ident),
+            "model": self.model.name,
+            "platform": dev.platform,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "device_count": 1,
+            "chunk": self.chunk,
+            "frontier_cap": self.FCAP,
+            "journal_cap": self.JCAP,
+            "max_seen_cap": self.MAX_SCAP,
+            "valid_cap": self.VC,
+            "canon_memo_cap": self.MCAP if self._use_memo else 0,
+            "symmetry": bool(self.canon.symmetry),
+            "invariants": list(self.invariants),
+            "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
 
     def _ckpt_ident(self) -> str:
         """Everything the saved run's soundness depends on: symmetry mode
@@ -829,6 +905,16 @@ class DeviceBFS:
     ):
         """Spill the resumable run state to an .npz (atomic rename).
         Saved at wave boundaries only, so the arrays are consistent."""
+        with self._tel.annotate("checkpoint"):
+            self._write_checkpoint(
+                path, frontier, jparent, jcand, fcount, scount, distinct,
+                total, terminal, depth, base_gid, gen_prev, depth_counts,
+            )
+
+    def _write_checkpoint(
+        self, path, frontier, jparent, jcand, fcount, scount, distinct,
+        total, terminal, depth, base_gid, gen_prev, depth_counts,
+    ):
         import os
 
         n0 = len(self._init_distinct)

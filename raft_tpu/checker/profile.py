@@ -97,6 +97,7 @@ def profile_stages(
     seen_cap: int = 1 << 21,
     warm_depth: int = 8,
     reps: int = 5,
+    telemetry=None,
     **caps,
 ) -> dict:
     """Profile the chunk pipeline on a realistic frontier.
@@ -104,7 +105,9 @@ def profile_stages(
     Runs a depth-capped BFS to ``warm_depth`` (checkpoint spill), then
     rebuilds one representative chunk's inputs from the spill and times
     each stage. Returns a dict with per-stage seconds, per-wave totals
-    and workload shape facts.
+    and workload shape facts. ``telemetry`` threads a raft_tpu.obs
+    Telemetry through the warm run (its manifest event records the
+    profiled engine's exact geometry and identity).
     """
     dev = DeviceBFS(
         model, invariants=invariants, symmetry=symmetry, chunk=chunk,
@@ -112,7 +115,8 @@ def profile_stages(
     )
     with tempfile.TemporaryDirectory() as td:
         ck_path = os.path.join(td, "warm.npz")
-        res = dev.run(max_depth=warm_depth, checkpoint_path=ck_path)
+        res = dev.run(max_depth=warm_depth, checkpoint_path=ck_path,
+                      telemetry=telemetry)
         if not os.path.exists(ck_path):
             raise RuntimeError(
                 f"workload exhausted at depth {res.depth} < warm_depth="

@@ -1,0 +1,47 @@
+"""raft_tpu.obs — run-time telemetry for the BFS engines.
+
+Live counterpart of the offline stage profiler (checker/profile.py):
+per-wave JSONL metrics (events.py), a TLC-style progress line
+(progress.py), jax.profiler trace hooks (trace.py) and the
+collector/facade threading them through the engines (collector.py).
+
+    from raft_tpu.obs import Telemetry
+    tel = Telemetry(metrics_path="m.jsonl", progress_every=10.0)
+    res = DeviceBFS(model, ...).run(telemetry=tel)
+    tel.close()
+"""
+
+from .collector import MetricsCollector, NULL_TELEMETRY, Telemetry
+from .events import (
+    DECLARED_EVENTS,
+    EVENT_KEYS,
+    EXIT_CAUSES,
+    MANIFEST_KEYS,
+    STALL_KEYS,
+    SUMMARY_KEYS,
+    WAVE_KEYS,
+    hashv_of,
+    validate_event,
+    validate_lines,
+)
+from .progress import ProgressRenderer, format_count
+from .trace import TraceHooks
+
+__all__ = [
+    "DECLARED_EVENTS",
+    "EVENT_KEYS",
+    "EXIT_CAUSES",
+    "MANIFEST_KEYS",
+    "STALL_KEYS",
+    "SUMMARY_KEYS",
+    "WAVE_KEYS",
+    "MetricsCollector",
+    "NULL_TELEMETRY",
+    "ProgressRenderer",
+    "Telemetry",
+    "TraceHooks",
+    "format_count",
+    "hashv_of",
+    "validate_event",
+    "validate_lines",
+]

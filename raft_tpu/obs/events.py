@@ -1,0 +1,150 @@
+"""Event schema of the live telemetry stream (the run-time counterpart
+of checker/profile.py's DECLARED_STAGES).
+
+A run emits one JSON object per line (JSONL), in order:
+
+  manifest   once per run(), before the first wave: everything a BENCH /
+             PROFILE artifact needs to cite its provenance — engine,
+             fingerprint-formula identity (the checkpoint ident string),
+             capacities, memo geometry, device/mesh topology.
+  wave       one per BFS wave (at the collector's cadence): depth,
+             frontier lanes, per-wave and cumulative generated/distinct,
+             canon-memo hit rate, terminal count, overflow bits, LSM
+             occupancy, wall seconds, rolling distinct/s.
+  stall      emitted by the wall-clock watchdog when a wave exceeds
+             stall_factor x the rolling median wave time.
+  summary    once per run(), after the last wave: final counts, exit
+             cause, peak buffer geometry, fleet memo hit rate.
+
+``DECLARED_EVENTS`` mirrors ``DECLARED_STAGES``: the tier-1 smoke test
+pins it, so the schema cannot silently rot when an engine's stats
+plumbing changes. Engines may add EXTRA keys (e.g. the sharded checker's
+all-to-all volume and per-shard skew); every DECLARED key must be
+present. This module is dependency-free (no jax/numpy) so schema
+validation runs anywhere — see scripts/check_metrics_schema.py.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+MANIFEST_KEYS = (
+    "event", "engine", "ident", "hashv", "model", "platform", "device",
+    "device_count", "chunk", "frontier_cap", "journal_cap",
+    "max_seen_cap", "valid_cap", "canon_memo_cap", "symmetry",
+    "invariants", "when",
+)
+
+WAVE_KEYS = (
+    "event", "wave", "depth", "frontier", "new", "distinct",
+    "generated", "generated_total", "terminal", "dedup_hit_rate",
+    "canon_memo_hits", "canon_memo_hit_rate", "overflow_bits",
+    "lsm_runs", "lsm_lanes", "wave_s", "elapsed_s", "distinct_per_s",
+)
+
+STALL_KEYS = (
+    "event", "wave", "depth", "wave_s", "median_wave_s", "factor",
+)
+
+SUMMARY_KEYS = (
+    "event", "engine", "ident", "exit_cause", "violation", "distinct",
+    "total", "depth", "terminal", "seconds", "distinct_per_s",
+    "exhausted", "waves", "stalls", "peak_frontier_cap",
+    "peak_journal_cap", "seen_lanes", "canon_memo_hit_rate",
+)
+
+DECLARED_EVENTS = (
+    ("manifest", MANIFEST_KEYS),
+    ("wave", WAVE_KEYS),
+    ("stall", STALL_KEYS),
+    ("summary", SUMMARY_KEYS),
+)
+
+EVENT_KEYS = dict(DECLARED_EVENTS)
+
+# exit causes a summary event may carry (one run, one cause)
+EXIT_CAUSES = (
+    "exhausted", "violation", "max_depth", "time_budget",
+)
+
+
+def hashv_of(ident: str) -> int:
+    """Fingerprint-formula revision from a checkpoint ident string (the
+    single source of truth for hashv — see DeviceBFS._ckpt_ident)."""
+    m = re.search(r"hashv=(\d+)", ident)
+    return int(m.group(1)) if m else 0
+
+
+def validate_event(ev: object, lineno: int | None = None) -> list[str]:
+    """Problems with one decoded event (empty list = valid). Extra keys
+    are allowed — engines extend the schema; they never shrink it."""
+    where = f"line {lineno}: " if lineno is not None else ""
+    if not isinstance(ev, dict):
+        return [f"{where}not a JSON object: {type(ev).__name__}"]
+    etype = ev.get("event")
+    if etype not in EVENT_KEYS:
+        return [
+            f"{where}unknown event type {etype!r} "
+            f"(declared: {', '.join(EVENT_KEYS)})"
+        ]
+    missing = [k for k in EVENT_KEYS[etype] if k not in ev]
+    problems = []
+    if missing:
+        problems.append(
+            f"{where}{etype} event missing declared keys: {missing}"
+        )
+    if etype == "summary" and ev.get("exit_cause") not in EXIT_CAUSES:
+        problems.append(
+            f"{where}summary exit_cause {ev.get('exit_cause')!r} not in "
+            f"{EXIT_CAUSES}"
+        )
+    return problems
+
+
+def validate_lines(lines) -> tuple[dict, list[str]]:
+    """Validate an iterable of JSONL lines against DECLARED_EVENTS.
+
+    Returns (counts, problems): counts maps event type -> occurrences.
+    Structural rules beyond per-event keys: every line must parse; wave
+    indices must be strictly increasing within a run (a new manifest
+    starts a new run and resets the expectation); a run's summary must
+    come after its waves.
+    """
+    counts: dict[str, int] = {}
+    problems: list[str] = []
+    last_wave = 0
+    summarized = False
+    for lineno, raw in enumerate(lines, start=1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            ev = json.loads(raw)
+        except ValueError as e:
+            problems.append(f"line {lineno}: not valid JSON ({e})")
+            continue
+        problems += validate_event(ev, lineno)
+        etype = ev.get("event") if isinstance(ev, dict) else None
+        if etype not in EVENT_KEYS:
+            continue
+        counts[etype] = counts.get(etype, 0) + 1
+        if etype == "manifest":
+            last_wave = 0
+            summarized = False
+        elif etype == "wave":
+            if summarized:
+                problems.append(
+                    f"line {lineno}: wave event after the run's summary"
+                )
+            w = ev.get("wave")
+            if not isinstance(w, int) or w <= last_wave:
+                problems.append(
+                    f"line {lineno}: wave index {w!r} not strictly "
+                    f"increasing (previous {last_wave})"
+                )
+            else:
+                last_wave = w
+        elif etype == "summary":
+            summarized = True
+    return counts, problems

@@ -1,0 +1,84 @@
+"""TLC-style live progress line on stderr.
+
+TLC's killer usability feature is the periodic progress report ("N
+states generated, M distinct states, queue depth D") — the reference
+workflow assumes you watch it for hours. This renderer is the
+equivalent, fed from the telemetry wave-event stream:
+
+    Progress (depth 7): 1.2M generated, 310k distinct, 2,648/s, memo 71%
+
+Throttled by wall clock (``every_s``); the first wave always prints so a
+short run is not silent. Stall events render immediately — a watchdog
+warning you cannot see is worthless.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def format_count(n) -> str:
+    """Humanized count: 1234 -> '1,234', 310000 -> '310k', 1.2e6 -> '1.2M'."""
+    n = int(n)
+    if n >= 1_000_000_000:
+        return f"{n / 1e9:.1f}B"
+    if n >= 1_000_000:
+        return f"{n / 1e6:.1f}M"
+    if n >= 10_000:
+        return f"{n / 1e3:.0f}k"
+    return f"{n:,}"
+
+
+class ProgressRenderer:
+    """Wave-event listener rendering the TLC-style progress line."""
+
+    # wave-event keys the renderer reads; the tier-1 smoke test asserts
+    # these stay inside events.WAVE_KEYS so the renderer and the schema
+    # cannot drift apart
+    CONSUMES = (
+        "depth", "generated_total", "distinct", "distinct_per_s",
+        "canon_memo_hit_rate",
+    )
+
+    def __init__(self, every_s: float = 10.0, stream=None):
+        self.every_s = float(every_s)
+        self.stream = stream if stream is not None else sys.stderr
+        self._last: float | None = None
+
+    def render_wave(self, ev: dict) -> str:
+        return (
+            f"Progress (depth {ev['depth']}): "
+            f"{format_count(ev['generated_total'])} generated, "
+            f"{format_count(ev['distinct'])} distinct, "
+            f"{ev['distinct_per_s']:,.0f}/s, "
+            f"memo {ev['canon_memo_hit_rate']:.0%}"
+        )
+
+    def __call__(self, ev: dict) -> None:
+        etype = ev.get("event")
+        if etype == "stall":
+            print(
+                f"Warning: wave {ev['wave']} (depth {ev['depth']}) took "
+                f"{ev['wave_s']:.1f}s — {ev['factor']:.1f}x the rolling "
+                f"median of {ev['median_wave_s']:.1f}s",
+                file=self.stream, flush=True,
+            )
+            return
+        if etype == "summary":
+            print(
+                f"Finished (depth {ev['depth']}): "
+                f"{format_count(ev['total'])} generated, "
+                f"{format_count(ev['distinct'])} distinct, "
+                f"{ev['terminal']} terminal, {ev['seconds']:.1f}s "
+                f"({ev['exit_cause']})",
+                file=self.stream, flush=True,
+            )
+            return
+        if etype != "wave":
+            return
+        now = time.monotonic()
+        if self._last is not None and now - self._last < self.every_s:
+            return
+        self._last = now
+        print(self.render_wave(ev), file=self.stream, flush=True)

@@ -41,6 +41,7 @@ shortest — counterexample.
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass
 
@@ -60,6 +61,8 @@ except AttributeError:  # 0.4.x keeps it in experimental; its replication
     _SHARD_MAP_KW = {"check_rep": False}
 
 from ..checker.lsm import CanonMemo, RunLSM, pow2_at_least
+from ..obs import NULL_TELEMETRY
+from ..obs.events import hashv_of
 from ..checker.util import (
     GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
 )
@@ -84,6 +87,9 @@ class ShardedResult:
     exhausted: bool = True
     trace: list[tuple[str, dict]] | None = None
     metrics: list[dict] | None = None  # per-wave (SURVEY.md §5.5)
+    # fleet aggregates: canon-memo hits/rate summed over shards plus
+    # per-shard skew (always populated; cheap host arithmetic)
+    stats: dict | None = None
 
 
 class ShardedBFS:
@@ -483,10 +489,15 @@ class ShardedBFS:
         checkpoint_path: str | None = None,
         checkpoint_every_s: float = 300.0,
         resume: str | None = None,
+        telemetry=None,
     ) -> ShardedResult:
         model, D, W, C = self.model, self.D, self.W, self.chunk
         t0 = time.perf_counter()
         exhausted = True
+        exit_cause = None
+        # telemetry rides the once-per-wave stats fetch the loop already
+        # does — zero extra collectives or device syncs
+        tel = telemetry if telemetry is not None else NULL_TELEMETRY
 
         init = np.asarray(model.init_states())
         init_fps = np.asarray(
@@ -614,19 +625,23 @@ class ShardedBFS:
             depth = 0
             depth_counts = [distinct]
 
+        tel.open_run(self._telemetry_manifest())
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
         # fresh per-shard memo per run: a pure cache, but starting empty
         # keeps consecutive runs of one engine byte-reproducible
         state["memo"] = self._memo.reset()
         memo_prev = 0
+        per_shard_memo = np.zeros(D, np.int64)
 
         while fcounts.sum() and violation is None:
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
+                exit_cause = "max_depth"
                 break
             if time_budget_s is not None and time.perf_counter() - t0 > time_budget_s:
                 exhausted = False
+                exit_cause = "time_budget"
                 break
             # top-absorb capacity guard, per chip (see DeviceBFS.run):
             # conservative — a chip's wave-new count is bounded by FCAP
@@ -652,21 +667,23 @@ class ShardedBFS:
                 base_lgid.astype(np.int32).reshape(D, 1), self._sharding)
             max_fc = int(fcounts.max())
             chunks_done = 0
-            for cursor in range(0, max_fc, C):
-                occ_dev = self._occ_dev()
-                chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
-                (state["next_buf"], state["jps"], state["jpl"],
-                 state["jcand"], state["viol"], state["stats"],
-                 state["memo"], new_run,
-                 ) = chunk_fn(
-                    state["frontier"], fc_dev, state["next_buf"],
-                    state["jps"], state["jpl"], state["jcand"],
-                    state["viol"], state["stats"], state["memo"],
-                    np.int32(cursor), occ_dev, bl_dev, *self._lsm.runs,
-                )
-                self._lsm.insert(new_run)
-                chunks_done += 1
-            stats_h, viol_h = jax.device_get((state["stats"], state["viol"]))
+            with tel.wave_annotation(depth + 1):
+                for cursor in range(0, max_fc, C):
+                    occ_dev = self._occ_dev()
+                    chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
+                    (state["next_buf"], state["jps"], state["jpl"],
+                     state["jcand"], state["viol"], state["stats"],
+                     state["memo"], new_run,
+                     ) = chunk_fn(
+                        state["frontier"], fc_dev, state["next_buf"],
+                        state["jps"], state["jpl"], state["jcand"],
+                        state["viol"], state["stats"], state["memo"],
+                        np.int32(cursor), occ_dev, bl_dev, *self._lsm.runs,
+                    )
+                    self._lsm.insert(new_run)
+                    chunks_done += 1
+                stats_h, viol_h = jax.device_get(
+                    (state["stats"], state["viol"]))
             stats_h = np.asarray(stats_h)  # [D,7]
             viol_h = np.asarray(viol_h)  # [D,K]
             new_d = stats_h[:, 0]
@@ -687,7 +704,9 @@ class ShardedBFS:
             memo_hits = int(stats_h[:, 6].sum())
             wave_memo = memo_hits - memo_prev
             memo_prev = memo_hits
+            per_shard_memo = stats_h[:, 6].copy()
             if global_new == 0:
+                exit_cause = "exhausted"
                 break
             depth += 1
             distinct += global_new
@@ -718,37 +737,49 @@ class ShardedBFS:
                 # per-chip floor is smaller than DeviceBFS's (1<<21):
                 # each chip holds ~1/D of the space
                 if self._lsm.lanes() > max(4 * int(scounts.max()), 1 << 20):
-                    self._lsm.consolidate(int(scounts.max()))
+                    with tel.annotate("consolidate"):
+                        self._lsm.consolidate(int(scounts.max()))
                 if (
                     checkpoint_path is not None
                     and time.perf_counter() - last_ckpt > checkpoint_every_s
                 ):
-                    self._save_checkpoint(
-                        checkpoint_path, state, fcounts, scounts, jcounts,
-                        n0, base_lgid, distinct, total, terminal + term_base,
-                        depth, gen_prev + gen_base,
-                        routed_prev + routed_base, depth_counts,
-                    )
+                    with tel.annotate("checkpoint"):
+                        self._save_checkpoint(
+                            checkpoint_path, state, fcounts, scounts,
+                            jcounts, n0, base_lgid, distinct, total,
+                            terminal + term_base, depth,
+                            gen_prev + gen_base,
+                            routed_prev + routed_base, depth_counts,
+                        )
                     last_ckpt = time.perf_counter()
-            if metrics is not None or verbose:
+            if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
                     "depth": depth,
                     "frontier": int(prev_fcounts.sum()),
                     "new": global_new,
+                    "distinct": distinct,
                     "generated": wave_gen,
+                    "generated_total": total,
+                    "terminal": terminal + term_base,
                     "dedup_hit_rate": round(1.0 - global_new / max(1, wave_gen), 4),
                     "canon_memo_hits": wave_memo,
                     "canon_memo_hit_rate": round(
                         wave_memo / max(1, wave_gen), 4
                     ),
+                    "overflow_bits": ovf_bits,
                     "wave_s": round(time.perf_counter() - tw, 3),
+                    "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
                     "a2a_lanes": wave_routed,
                     "a2a_bytes": wave_routed * (4 * (W + 2) + 8),
                     "shard_new": [int(x) for x in new_d],
+                    "shard_new_min": int(new_d.min()),
+                    "shard_new_max": int(new_d.max()),
                     "lsm_runs": sum(self._lsm.occ),
+                    "lsm_lanes": int(self._lsm.lanes()),
                 }
+                tel.wave(wm)
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -756,7 +787,8 @@ class ShardedBFS:
                         f"depth {depth}: +{global_new} distinct={distinct} "
                         f"a2a={wave_routed} lanes "
                         f"balance={new_d.min()}/{new_d.max()} "
-                        f"({distinct/el:.0f} distinct/s)")
+                        f"({distinct/el:.0f} distinct/s)",
+                        file=sys.stderr)
 
         if (checkpoint_path is not None and violation is None
                 and not exhausted):
@@ -773,6 +805,42 @@ class ShardedBFS:
         self._journals = (jps_h, jpl_h, jcand_h, jcounts.copy(), n0.copy())
 
         dt = time.perf_counter() - t0
+        if violation is not None:
+            exit_cause = "violation"
+        elif exit_cause is None:
+            exit_cause = "exhausted"
+        # fleet aggregates (satellite of the telemetry PR): memo hit
+        # totals + per-shard skew, from the SAME host stats the loop
+        # already fetched — also returned on ShardedResult.stats
+        fleet_rate = round(memo_prev / max(1, gen_prev), 4)
+        fleet_stats = {
+            "canon_memo_hits": memo_prev,
+            "canon_memo_hit_rate": fleet_rate,
+            "shard_memo_hits": [int(x) for x in per_shard_memo],
+            "shard_distinct": [int(x) for x in scounts],
+            "shard_skew": round(
+                int(scounts.max()) / max(1, int(scounts.min())), 3),
+        }
+        tel.close_run({
+            "engine": "sharded",
+            "ident": self._ckpt_ident(),
+            "exit_cause": exit_cause,
+            "violation": violation,
+            "distinct": distinct,
+            "total": total,
+            "depth": depth,
+            "terminal": terminal + term_base,
+            "seconds": round(dt, 3),
+            "distinct_per_s": round(distinct / dt, 1) if dt > 0 else 0.0,
+            "exhausted": exhausted and violation is None,
+            "peak_frontier_cap": self.FCAP,
+            "peak_journal_cap": self.JCAP,
+            "seen_lanes": int(self._lsm.lanes()),
+            "canon_memo_hit_rate": fleet_rate,
+            # sharded extras (schema allows extra keys)
+            "shard_memo_hits": fleet_stats["shard_memo_hits"],
+            "shard_skew": fleet_stats["shard_skew"],
+        })
         trace = init_trace
         if violation is not None and viol_site is not None:
             trace = self.reconstruct_trace(viol_site)
@@ -788,7 +856,31 @@ class ShardedBFS:
             exhausted=exhausted and violation is None,
             trace=trace,
             metrics=metrics,
+            stats=fleet_stats,
         )
+
+    def _telemetry_manifest(self) -> dict:
+        """Run-provenance fields of the telemetry manifest event."""
+        dev = self.mesh.devices.flat[0]
+        ident = self._ckpt_ident()
+        return {
+            "engine": "sharded",
+            "ident": ident,
+            "hashv": hashv_of(ident),
+            "model": self.model.name,
+            "platform": dev.platform,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "device_count": self.D,
+            "chunk": self.chunk,
+            "frontier_cap": self.FCAP,
+            "journal_cap": self.JCAP,
+            "max_seen_cap": self.MAX_SCAP,
+            "valid_cap": self.VC,
+            "canon_memo_cap": self.MCAP if self._use_memo else 0,
+            "symmetry": bool(self.canon.symmetry),
+            "invariants": list(self.invariants),
+            "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
 
     def _check_init(self, init_d: np.ndarray):
         """(invariant name, index of first bad init state) or None."""

@@ -37,6 +37,16 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 REF = "/root/reference/specifications"
 
 
+def manifest_fields(tel) -> dict:
+    """Provenance subset of the telemetry manifest event, attached to
+    every row so a BENCH_ROWS number carries the fingerprint-formula
+    revision, memo geometry and device kind that produced it."""
+    man = next((e for e in tel.events if e["event"] == "manifest"), {})
+    return {k: man.get(k) for k in
+            ("ident", "hashv", "canon_memo_cap", "device", "platform",
+             "chunk")}
+
+
 def gate(model, invs, depth, chunks=(1024, 2048), **caps):
     from raft_tpu.checker.parity import parity_gate
 
@@ -64,8 +74,12 @@ def cmp_and_deep(model, invs, oracle, cmp_depth, chunk=2048,
     t_oracle = time.perf_counter() - t0
     match = (ores["distinct"] == dres.distinct
              and ores["depth_counts"] == dres.depth_counts)
-    deep = dev.run(time_budget_s=BUDGET)
+    from raft_tpu.obs import Telemetry
+
+    tel = Telemetry()
+    deep = dev.run(time_budget_s=BUDGET, telemetry=tel)
     return {
+        "manifest": manifest_fields(tel),
         "same_depth_cmp": {
             "depth": cmp_depth,
             "distinct": dres.distinct,
@@ -110,8 +124,12 @@ def row2():
                     max_seen_cap=1 << 25, max_journal_cap=1 << 25)
     dev.run(max_depth=1)  # compile outside the budgeted window (the v3
     # canonicalizer's three tiers push compile past 2 min on this chip)
-    deep = dev.run(time_budget_s=BUDGET, collect_metrics=True)
+    from raft_tpu.obs import Telemetry
+
+    tel = Telemetry()
+    deep = dev.run(time_budget_s=BUDGET, collect_metrics=True, telemetry=tel)
     last = deep.metrics[-1] if deep.metrics else {}
+    out["manifest"] = manifest_fields(tel)
     out["deep"] = {
         "distinct": deep.distinct,
         "depth": deep.depth,
@@ -188,7 +206,11 @@ def row5():
                     chunk=1024, frontier_cap=1 << 17, seen_cap=1 << 21,
                     journal_cap=1 << 21)
     dev.run(max_depth=1)  # compile outside the budgeted window
-    deep = dev.run(time_budget_s=BUDGET)
+    from raft_tpu.obs import Telemetry
+
+    tel = Telemetry()
+    deep = dev.run(time_budget_s=BUDGET, telemetry=tel)
+    out["manifest"] = manifest_fields(tel)
     out["bounded_bfs"] = {
         "distinct": deep.distinct,
         "depth": deep.depth,

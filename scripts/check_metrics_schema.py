@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Validate --metrics-out JSONL files against the declared event schema.
+
+    python scripts/check_metrics_schema.py m.jsonl [more.jsonl ...]
+
+Checks every line against raft_tpu.obs.events.DECLARED_EVENTS (the same
+tuple the tier-1 smoke test pins): valid JSON per line, known event
+type, every declared key present, wave indices strictly increasing
+within a run, no wave after a run's summary, and a legal exit_cause on
+each summary. Exit status 0 iff every file is clean — bench.py runs
+this after each telemetry-enabled run.
+
+Dependency-free on purpose (no jax/numpy import happens): schema
+validation must work on a machine with nothing but the repo checked
+out, e.g. when auditing a metrics file copied off a TPU host.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu.obs.events import validate_lines  # noqa: E402
+
+
+def validate_file(path: str) -> tuple[dict, list[str]]:
+    """(event-type counts, problems) for one JSONL file."""
+    with open(path) as fh:
+        counts, problems = validate_lines(fh)
+    if not counts:
+        problems = [*problems, "no events at all (empty stream)"]
+    elif "manifest" not in counts:
+        problems = [*problems, "stream has no manifest event"]
+    return counts, problems
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 64
+    rc = 0
+    for path in argv:
+        try:
+            counts, problems = validate_file(path)
+        except OSError as e:
+            print(f"{path}: cannot read ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        summary = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        if problems:
+            rc = 1
+            print(f"{path}: INVALID ({summary})", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+        else:
+            print(f"{path}: ok ({summary})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -107,7 +107,17 @@ def main():
             continue
         model, invs, kw = WL[name]()
         print(f"=== {name} ===", flush=True)
-        prof = profile_stages(model, invariants=invs, symmetry=True, **kw)
+        from raft_tpu.obs import Telemetry
+
+        tel = Telemetry()  # in-memory: the manifest event is the
+        # workload's provenance record (ident/hashv/memo geometry)
+        prof = profile_stages(model, invariants=invs, symmetry=True,
+                              telemetry=tel, **kw)
+        man = next((e for e in tel.events if e["event"] == "manifest"), {})
+        prof["manifest"] = {
+            k: man.get(k) for k in
+            ("ident", "hashv", "canon_memo_cap", "device", "platform")
+        }
         results[name] = prof
         done.append(name)
         print(render(prof), flush=True)
@@ -115,6 +125,13 @@ def main():
             json.dump(results, f, indent=1)
 
     md = ["# Stage-level profile of the DeviceBFS hot loop",
+          "",
+          "This file attributes time WITHIN a wave, offline, by",
+          "re-running each pipeline stage in isolation. For live",
+          "wall-clock numbers — per-wave seconds, sustained distinct/s,",
+          "memo hit rate over a real run — use the runtime telemetry",
+          "stream instead (`--progress` / `--metrics-out`; README",
+          "\"Observability\").",
           "",
           f"Device: {results['meta']['device']} "
           f"({results['meta']['when']}). Produced by "

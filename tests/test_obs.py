@@ -1,0 +1,418 @@
+"""Live telemetry (raft_tpu/obs): JSONL stream validity, zero extra
+device syncs, watchdog, schema/renderer lock-step, fleet stats, CLI.
+
+The headline guarantees pinned here:
+
+  * the metrics stream is count-accurate — the final cumulative
+    ``distinct`` in the wave stream equals the checker's reported
+    distinct, at any cadence;
+  * attaching a collector adds ZERO extra ``jax.device_get`` calls and
+    leaves the result bit-identical (telemetry reuses the once-per-wave
+    host snapshot the loop already fetches);
+  * the progress renderer only consumes declared WAVE_KEYS, so the
+    stderr line and the JSONL schema cannot drift apart.
+"""
+
+import io
+import json
+
+import pytest
+
+from raft_tpu.obs import (
+    DECLARED_EVENTS,
+    MANIFEST_KEYS,
+    STALL_KEYS,
+    SUMMARY_KEYS,
+    WAVE_KEYS,
+    MetricsCollector,
+    ProgressRenderer,
+    Telemetry,
+    format_count,
+    hashv_of,
+    validate_lines,
+)
+from raft_tpu.models.raft import RaftParams, cached_model
+
+SMALL = RaftParams(
+    n_servers=2, n_values=1, max_elections=1, max_restarts=0, msg_slots=16
+)
+INVS = ("LeaderHasAllAckedValues", "NoLogDivergence")
+
+
+def _device(**kw):
+    from raft_tpu.checker.device_bfs import DeviceBFS
+
+    kw.setdefault("chunk", 256)
+    kw.setdefault("frontier_cap", 1 << 12)
+    kw.setdefault("seen_cap", 1 << 15)
+    kw.setdefault("journal_cap", 1 << 15)
+    return DeviceBFS(cached_model(SMALL), invariants=INVS, symmetry=True, **kw)
+
+
+# ---------------------------------------------------------------- stream
+
+
+def test_device_metrics_stream_valid_and_count_accurate(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with Telemetry(metrics_path=str(path)) as tel:
+        res = _device().run(max_depth=4, telemetry=tel)
+    with open(path) as fh:
+        lines = fh.readlines()
+    counts, problems = validate_lines(lines)
+    assert not problems, problems
+    assert counts["manifest"] == 1 and counts["summary"] == 1
+    assert counts["wave"] >= 4  # >= depth-many wave events
+
+    events = [json.loads(ln) for ln in lines]
+    assert events[0]["event"] == "manifest"
+    assert events[-1]["event"] == "summary"
+    man, summ = events[0], events[-1]
+    waves = [e for e in events if e["event"] == "wave"]
+
+    # every declared key present on every event
+    for ev, keys in zip((man, waves[0], summ), (MANIFEST_KEYS, WAVE_KEYS, SUMMARY_KEYS)):
+        assert all(k in ev for k in keys), (ev["event"], keys)
+
+    # count-accuracy: cumulative distinct in the stream == result
+    assert waves[-1]["distinct"] == res.distinct
+    assert summ["distinct"] == res.distinct
+    assert summ["total"] == res.total
+    assert summ["depth"] == res.depth
+    assert summ["exit_cause"] == "max_depth"
+    assert summ["waves"] == len(waves)
+    # wave index strictly increasing from 1
+    assert [w["wave"] for w in waves] == list(range(1, len(waves) + 1))
+
+    # manifest provenance: ident carries the fingerprint revision
+    assert man["engine"] == "device"
+    assert man["hashv"] == hashv_of(man["ident"]) > 0
+    assert man["symmetry"] is True
+
+
+def test_cadence_keeps_stream_count_accurate(tmp_path):
+    path = tmp_path / "m2.jsonl"
+    with Telemetry(metrics_path=str(path), every=3) as tel:
+        res = _device().run(max_depth=5, telemetry=tel)
+    with open(path) as fh:
+        lines = fh.readlines()
+    _, problems = validate_lines(lines)
+    assert not problems, problems
+    waves = [json.loads(ln) for ln in lines if '"wave"' in ln]
+    waves = [w for w in waves if w["event"] == "wave"]
+    assert 0 < len(waves) < 5  # thinned by cadence...
+    # ...but the LAST wave is always flushed so the tail stays accurate
+    assert waves[-1]["distinct"] == res.distinct
+
+
+def test_telemetry_adds_zero_device_syncs_and_is_bit_identical(monkeypatch):
+    import jax
+
+    eng = _device()
+    eng.run(max_depth=4)  # warm the compile cache outside the count
+
+    real = jax.device_get
+    calls = {"n": 0}
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    bare = eng.run(max_depth=4)
+    n_bare = calls["n"]
+
+    calls["n"] = 0
+    tel = Telemetry()
+    instrumented = eng.run(max_depth=4, telemetry=tel)
+    n_tel = calls["n"]
+    monkeypatch.undo()
+
+    assert n_tel == n_bare, (
+        f"telemetry added {n_tel - n_bare} device_get syncs per run"
+    )
+    assert instrumented.distinct == bare.distinct
+    assert instrumented.depth_counts == bare.depth_counts
+    assert instrumented.total == bare.total
+    assert instrumented.terminal == bare.terminal
+    assert len(tel.wave_events()) >= 4
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def _fields(keys, **kw):
+    """All declared keys zeroed except event/wave (the collector owns
+    those), overridden by kw."""
+    ev = dict.fromkeys(keys, 0)
+    ev.pop("event", None)
+    ev.pop("wave", None)
+    ev.update(kw)
+    return ev
+
+
+def _wave(depth, wave_s):
+    return _fields(WAVE_KEYS, depth=depth, wave_s=wave_s)
+
+
+def test_watchdog_flags_stall_against_prior_median():
+    c = MetricsCollector(stall_factor=4.0, stall_min_waves=5)
+    c.manifest(_fields(MANIFEST_KEYS))
+    for d in range(5):
+        c.wave(_wave(d, 1.0))
+    assert c.stalls == 0
+    c.wave(_wave(5, 10.0))  # 10x the rolling median of 1.0
+    assert c.stalls == 1
+    stall = c.events_of("stall")[0]
+    assert all(k in stall for k in STALL_KEYS)
+    assert stall["factor"] == pytest.approx(10.0)
+    assert stall["median_wave_s"] == pytest.approx(1.0)
+    # judged BEFORE joining the window: an immediate second slow wave
+    # still compares against the healthy median
+    c.wave(_wave(6, 10.0))
+    assert c.stalls == 2
+    c.summary(_fields(SUMMARY_KEYS))
+    assert c.last_summary["stalls"] == 2
+
+    # too few samples -> never fires (no median to trust yet)
+    c2 = MetricsCollector(stall_min_waves=5)
+    c2.manifest(_fields(MANIFEST_KEYS))
+    for d in range(4):
+        c2.wave(_wave(d, 1.0))
+    c2.wave(_wave(4, 50.0))
+    assert c2.stalls == 0
+
+
+# -------------------------------------------- schema/renderer lock-step
+
+
+def test_schema_and_renderer_stay_in_sync():
+    # the contract check_metrics_schema.py and the engines share
+    assert tuple(n for n, _ in DECLARED_EVENTS) == (
+        "manifest", "wave", "stall", "summary",
+    )
+    for _, keys in DECLARED_EVENTS:
+        assert keys[0] == "event"
+        assert len(set(keys)) == len(keys)
+    # the renderer may only read declared wave keys
+    assert set(ProgressRenderer.CONSUMES) <= set(WAVE_KEYS)
+
+    ev = dict.fromkeys(WAVE_KEYS, 0)
+    ev.update(event="wave", depth=7, generated_total=1_200_000,
+              distinct=310_000, distinct_per_s=2648.0,
+              canon_memo_hit_rate=0.71)
+    line = ProgressRenderer().render_wave(ev)
+    assert line == (
+        "Progress (depth 7): 1.2M generated, 310k distinct, 2,648/s, "
+        "memo 71%"
+    )
+
+    out = io.StringIO()
+    r = ProgressRenderer(every_s=0.0, stream=out)
+    r(ev)
+    r({"event": "stall", "wave": 9, "depth": 7, "wave_s": 8.0,
+       "median_wave_s": 1.0, "factor": 8.0})
+    summ = dict.fromkeys(SUMMARY_KEYS, 0)
+    summ.update(event="summary", exit_cause="exhausted", seconds=1.0)
+    r(summ)
+    text = out.getvalue()
+    assert "Progress (depth 7)" in text
+    assert "Warning: wave 9" in text
+    assert "Finished" in text and "(exhausted)" in text
+
+
+def test_format_count():
+    assert format_count(1234) == "1,234"
+    assert format_count(310_000) == "310k"
+    assert format_count(1_200_000) == "1.2M"
+    assert format_count(3_400_000_000) == "3.4B"
+
+
+# --------------------------------------------------- schema validation
+
+
+def test_check_metrics_schema_script(tmp_path):
+    from scripts.check_metrics_schema import main, validate_file
+
+    good = tmp_path / "good.jsonl"
+    c = MetricsCollector(path=str(good))
+    c.manifest(_fields(MANIFEST_KEYS, ident="x/hashv=5"))
+    for d in range(3):
+        c.wave(_wave(d, 0.5))
+    c.summary(_fields(SUMMARY_KEYS, exit_cause="exhausted"))
+    c.close()
+    counts, problems = validate_file(str(good))
+    assert not problems, problems
+    assert counts == {"manifest": 1, "wave": 3, "summary": 1}
+    assert main([str(good)]) == 0
+
+    bad = tmp_path / "bad.jsonl"
+    lines = good.read_text().splitlines()
+    w1 = json.loads(lines[1])
+    del w1["distinct"]  # missing declared key
+    w1["wave"] = 7  # breaks strict increase for the next wave
+    lines[1] = json.dumps(w1)
+    bad.write_text("\n".join(lines) + "\n{not json\n")
+    _, problems = validate_file(str(bad))
+    text = "\n".join(problems)
+    assert "missing declared keys" in text
+    assert "strictly" in text
+    assert "not valid JSON" in text
+    assert main([str(bad)]) == 1
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    _, problems = validate_file(str(empty))
+    assert any("empty stream" in p for p in problems)
+    assert main([]) == 64
+
+
+def test_double_buffered_write_lags_by_one(tmp_path):
+    path = tmp_path / "buf.jsonl"
+    c = MetricsCollector(path=str(path))
+    c.manifest(_fields(MANIFEST_KEYS))
+    c.wave(_wave(0, 0.1))
+    c._fh.flush()
+    on_disk = path.read_text().splitlines()
+    assert len(on_disk) == 1  # wave 1 still pending; manifest flushed
+    c.close()
+    assert len(path.read_text().splitlines()) == 2
+
+
+# ----------------------------------------------------- engines: others
+
+
+@pytest.mark.slow
+def test_host_checker_stream(tmp_path):
+    from raft_tpu.checker.bfs import BFSChecker
+
+    path = tmp_path / "host.jsonl"
+    with Telemetry(metrics_path=str(path)) as tel:
+        res = BFSChecker(
+            cached_model(SMALL), invariants=INVS, symmetry=True, chunk=256
+        ).run(telemetry=tel)
+    with open(path) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+    waves = tel.wave_events()
+    assert waves[-1]["distinct"] == res.distinct
+    assert tel.last_summary["engine"] == "host"
+    assert tel.last_summary["exit_cause"] == "exhausted"
+
+
+@pytest.mark.slow
+def test_sharded_stream_and_fleet_stats(tmp_path):
+    import jax
+
+    from raft_tpu.parallel.sharded import ShardedBFS
+
+    path = tmp_path / "shard.jsonl"
+    engine = ShardedBFS(
+        cached_model(SMALL), invariants=INVS, symmetry=True,
+        devices=jax.devices()[:4], chunk=512, frontier_cap=1024,
+        seen_cap=1 << 12,
+    )
+    with Telemetry(metrics_path=str(path)) as tel:
+        res = engine.run(telemetry=tel)
+    with open(path) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+    assert counts["manifest"] == 1 and counts["summary"] == 1
+    man = tel.events[0]
+    assert man["engine"] == "sharded" and man["device_count"] == 4
+    assert tel.wave_events()[-1]["distinct"] == res.distinct
+
+    # satellite: aggregated fleet memo stats + per-shard skew on the
+    # returned result
+    assert res.stats is not None
+    for k in ("canon_memo_hits", "canon_memo_hit_rate", "shard_memo_hits",
+              "shard_distinct", "shard_skew"):
+        assert k in res.stats, k
+    assert len(res.stats["shard_memo_hits"]) == 4
+    assert sum(res.stats["shard_distinct"]) == res.distinct
+    assert res.stats["shard_skew"] >= 1.0
+    assert tel.last_summary["canon_memo_hit_rate"] == res.stats[
+        "canon_memo_hit_rate"
+    ]
+
+
+# ----------------------------------------------------------------- CLI
+
+
+CFG = """\
+CONSTANTS
+    n1 = n1
+    n2 = n2
+    v1 = v1
+    Server = { n1, n2 }
+    Value = { v1 }
+    Follower = Follower
+    Candidate = Candidate
+    Leader = Leader
+    Nil = Nil
+    RequestVoteRequest = RequestVoteRequest
+    RequestVoteResponse = RequestVoteResponse
+    AppendEntriesRequest = AppendEntriesRequest
+    AppendEntriesResponse = AppendEntriesResponse
+    EqualTerm = EqualTerm
+    LessOrEqualTerm = LessOrEqualTerm
+    MaxElections = 1
+    MaxRestarts = 0
+
+INIT Init
+NEXT Next
+
+INVARIANT
+NoLogDivergence
+"""
+
+CLI_BASE = [
+    "--platform", "cpu", "--msg-slots", "16", "--max-depth", "4",
+    "--chunk", "256", "--frontier-cap", "4096", "--seen-cap", "16384",
+    "--journal-cap", "16384",
+]
+
+
+@pytest.mark.slow
+def test_cli_json_progress_and_bit_identical_result(tmp_path, capsys):
+    from raft_tpu.__main__ import main
+
+    cfg = tmp_path / "Raft.cfg"
+    cfg.write_text(CFG)
+    mpath = tmp_path / "cli.jsonl"
+
+    rc = main([str(cfg), *CLI_BASE, "--progress=0",
+               "--metrics-out", str(mpath), "--json"])
+    cap = capsys.readouterr()
+    assert rc == 0, cap.err
+
+    # stdout: result lines only, summary event as the LAST line
+    out_lines = cap.out.strip().splitlines()
+    summ = json.loads(out_lines[-1])
+    assert summ["event"] == "summary"
+    assert summ["exit_cause"] == "max_depth"
+    result_line = next(ln for ln in out_lines if ln.startswith("distinct="))
+    assert f"distinct={summ['distinct']}" in result_line
+
+    # stderr: banner + live progress, never stdout
+    assert "spec=" in cap.err
+    assert "Progress (depth" in cap.err
+    assert "Progress (depth" not in cap.out
+
+    # the file on disk is schema-clean and count-accurate
+    with open(mpath) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+    assert counts["wave"] >= 4
+
+    # telemetry must not perturb the result: identical result line
+    # without any telemetry flag
+    rc = main([str(cfg), *CLI_BASE])
+    cap = capsys.readouterr()
+    assert rc == 0, cap.err
+    bare_line = next(
+        ln for ln in cap.out.strip().splitlines()
+        if ln.startswith("distinct=")
+    )
+    # wall-clock fields differ run to run; the counts must not
+    strip = lambda s: s.split(" time=")[0]  # noqa: E731
+    assert strip(bare_line) == strip(result_line)
