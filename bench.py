@@ -149,7 +149,7 @@ def main():
     from raft_tpu.models.registry import build_from_cfg
     from raft_tpu.checker.device_bfs import DeviceBFS
     from raft_tpu.checker.parity import parity_gate
-    from raft_tpu.obs import Telemetry
+    from raft_tpu.obs import Telemetry, coverage_digest
 
     cfg = parse_cfg(CFG)
     setup = build_from_cfg(cfg, msg_slots=32)
@@ -278,6 +278,13 @@ def main():
                 "exhausted": deep.exhausted,
                 "seconds": round(deep.seconds, 2),
                 "violation": deep.violation.invariant if deep.violation else None,
+                # action-coverage digest: a rate number also says how
+                # much of the Next relation the run exercised
+                "coverage": (
+                    coverage_digest(model.ACTION_NAMES, deep.coverage)
+                    if deep.coverage is not None
+                    and getattr(model, "ACTION_NAMES", None) else None
+                ),
             },
             "dispatch_floor_ms": round(floor_s * 1e3, 1),
             "precompile_s": round(precompile_s, 1),

@@ -120,6 +120,18 @@ def main(argv=None):
         "per SECS seconds, default 10; stall warnings print immediately)",
     )
     ap.add_argument(
+        "--coverage",
+        nargs="?",
+        const="table",
+        choices=["table", "strict"],
+        default=None,
+        help="after the run, print a TLC-style per-action coverage table "
+        "(enabled / fired / new-distinct states per action, cumulative "
+        "over the run) with WARNING lines for actions that never fired; "
+        "--coverage=strict additionally exits 3 when any action never "
+        "fired (dead-action gate for CI); BFS checkers only",
+    )
+    ap.add_argument(
         "--metrics-out",
         default=None,
         metavar="PATH",
@@ -227,6 +239,16 @@ def main(argv=None):
         print(
             f"error: spec {setup.model.name} has no TPU lowering yet; use "
             "--checker oracle (exhaustive or --simulate)",
+            file=sys.stderr,
+        )
+        return 64
+
+    if args.coverage is not None and (
+        args.checker == "oracle" or args.simulate is not None
+    ):
+        print(
+            "error: --coverage needs a BFS checker (tpu, sharded, or "
+            "tpu-host) and no --simulate",
             file=sys.stderr,
         )
         return 64
@@ -448,6 +470,24 @@ def main(argv=None):
         res.violation_invariant if args.checker == "sharded"
         else (res.violation.invariant if res.violation else None)
     )
+
+    def _print_coverage() -> int:
+        """TLC-style per-action coverage table (--coverage); returns the
+        strict-mode exit code (3 when an action never fired)."""
+        if args.coverage is None:
+            return 0
+        cov = getattr(res, "coverage", None)
+        names = getattr(setup.model, "ACTION_NAMES", None)
+        if cov is None or not names:
+            print("coverage: not available for this spec", file=sys.stderr)
+            return 0
+        from .obs import dead_actions, render_coverage_table
+
+        print(render_coverage_table(names, cov))
+        if args.coverage == "strict" and dead_actions(names, cov):
+            return 3
+        return 0
+
     print(
         f"distinct={res.distinct} total={res.total} depth={res.depth} "
         f"terminal={res.terminal} time={res.seconds:.2f}s "
@@ -464,8 +504,10 @@ def main(argv=None):
                 print(format_trace_tlc(res.trace, setup, viol_name))
             else:
                 print(format_trace(res.trace, setup))
+        _print_coverage()  # violation rc 2 outranks the strict gate
         return _finish(2)
     print("no invariant violations")
+    cov_rc = _print_coverage()
 
     if props:
         from .checker.liveness import LivenessChecker
@@ -502,7 +544,7 @@ def main(argv=None):
                     print(format_trace(v.cycle, setup))
             return _finish(2)
         print("no temporal property violations")
-    return _finish(0)
+    return _finish(cov_rc)
 
 
 if __name__ == "__main__":

@@ -66,6 +66,10 @@ class CheckResult:
     exhausted: bool = True  # False if stopped by max_depth/time budget
     trace: list[tuple[str, dict]] | None = None  # (action label, decoded state)
     metrics: list[dict] | None = None  # per-wave metrics (SURVEY.md §5.5)
+    # per-action [enabled, fired, new-distinct] in ACTION_NAMES rank
+    # order (TLC -coverage analog); None for models without the
+    # rank/name contract
+    coverage: list[list[int]] | None = None
 
 
 class BFSChecker:
@@ -81,6 +85,7 @@ class BFSChecker:
         self.invariants = tuple(invariants)
         self.chunk = chunk
         self.check_deadlock = check_deadlock
+        self.n_actions = len(getattr(model, "ACTION_NAMES", ()))
         self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
         self._expand = model.expand
         self._fps = self.canon.fingerprints
@@ -123,6 +128,8 @@ class BFSChecker:
         depth_counts = [distinct]
         terminal = 0
         violation = None
+        K = self.n_actions
+        cov = np.zeros((K, 3), dtype=np.int64)  # [enabled, fired, new]/rank
 
         viol = self._check_invariants(frontier, 0, 0)
         if viol is not None:
@@ -159,13 +166,27 @@ class BFSChecker:
                     if nb < B:  # pad to the compiled batch shape
                         pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
                         chunk_states = np.concatenate([chunk_states, pad], axis=0)
-                    succs, valid, _rank, ovf = self._expand(chunk_states)
-                    valid = np.array(jax.device_get(valid))
+                    succs, valid, rank, ovf = self._expand(chunk_states)
+                    # one fetch for the three per-lane outputs (rank now
+                    # feeds the coverage accumulator)
+                    valid, rank, ovf = (
+                        np.array(x)
+                        for x in jax.device_get((valid, rank, ovf))
+                    )
                     valid[nb:] = False
-                    if np.any(valid & np.asarray(jax.device_get(ovf))):
+                    if np.any(valid & ovf):
                         raise OverflowError(
                             "message-slot overflow: re-run with a larger msg_slots"
                         )
+                    if K:
+                        # numpy mirror of DeviceBFS._chunk_step 4b:
+                        # invalid lanes route to drop bucket K
+                        rk = np.where(valid, rank, K)
+                        flat_rk = rk.reshape(-1)
+                        cov[:, 1] += np.bincount(flat_rk, minlength=K + 1)[:K]
+                        hit = np.zeros((len(valid), K + 1), dtype=bool)
+                        hit[np.arange(len(valid))[:, None], rk] = True
+                        cov[:, 0] += hit[:, :K].sum(axis=0)
                     flat = succs.reshape(-1, model.layout.W)
                     fps = np.array(jax.device_get(self._fps(flat)), dtype=np.uint64)
                     fps[~valid.reshape(-1)] = U64_MAX
@@ -182,6 +203,9 @@ class BFSChecker:
                     first[first_idx] = True
                     new_mask &= first
                     idx = np.nonzero(new_mask)[0]
+                    if K:
+                        cov[:, 2] += np.bincount(
+                            flat_rk[idx], minlength=K + 1)[:K]
                     if len(idx):
                         sel = np.asarray(jax.device_get(flat[idx]))
                         new_states.append(sel)
@@ -233,6 +257,9 @@ class BFSChecker:
                     "distinct_per_s": round(distinct / el, 1),
                 }
                 tel.wave(wm)
+                if tel.active:
+                    tel.coverage(self._coverage_fields(
+                        depth, cov, len(seen), depth_counts))
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -248,6 +275,11 @@ class BFSChecker:
             exit_cause = "violation"
         elif exit_cause is None:
             exit_cause = "exhausted"
+        if tel.active:
+            tel.coverage(
+                self._coverage_fields(depth, cov, len(seen), depth_counts),
+                final=True,
+            )
         tel.close_run({
             "engine": "host",
             "ident": self._ckpt_ident(),
@@ -278,7 +310,26 @@ class BFSChecker:
             exhausted=exhausted and violation is None,
             trace=trace,
             metrics=metrics,
+            coverage=[[int(x) for x in row] for row in cov] if K else None,
         )
+
+    def _coverage_fields(self, depth, cov, seen_len, depth_counts) -> dict:
+        """Coverage-event payload (events.COVERAGE_KEYS). The host engine
+        keeps one flat sorted seen array (plus the in-wave probe set), so
+        the dedup-structure gauges are trivial and there is no canon
+        memo."""
+        return {
+            "depth": depth,
+            "actions": [[int(x) for x in row] for row in cov],
+            "actions_total": self.n_actions,
+            "actions_fired": int(np.count_nonzero(cov[:, 1]))
+            if self.n_actions else 0,
+            "seen_lanes": [int(seen_len)],
+            "seen_real": int(seen_len),
+            "probe_runs": 2,  # global seen + current-wave fingerprints
+            "frontier_hist": [int(x) for x in depth_counts],
+            "canon_memo_fill": None,  # host engine has no canon memo
+        }
 
     def _ckpt_ident(self) -> str:
         """Same identity grammar as the device engines (hashv marks the
@@ -312,6 +363,7 @@ class BFSChecker:
             "canon_memo_cap": 0,
             "symmetry": bool(self.canon.symmetry),
             "invariants": list(self.invariants),
+            "action_names": list(getattr(self.model, "ACTION_NAMES", ())),
             "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
 

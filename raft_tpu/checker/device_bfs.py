@@ -102,6 +102,10 @@ class DeviceBFS:
         self.check_deadlock = check_deadlock
         self.A = model.A
         self.W = model.layout.W
+        # per-action coverage width: one row per Next-disjunct rank
+        # (the model's ACTION_NAMES order); 0 disables accumulation for
+        # models predating the rank/name contract
+        self.n_actions = len(getattr(model, "ACTION_NAMES", ()))
         self.FCAP = frontier_cap
         self.JCAP = journal_cap
         self.MAX_FCAP = max(max_frontier_cap, frontier_cap)
@@ -151,13 +155,13 @@ class DeviceBFS:
         )
         self._memo = CanonMemo(canon_memo_cap if self._use_memo else 1)
         self.MCAP = self._memo.MCAP
-        # donated: next_buf, jparent, jcand, viol, stats, memo
+        # donated: next_buf, jparent, jcand, viol, stats, memo, cov
         # (seen read-only)
         self._chunk_fn = jax.jit(
-            self._chunk_step, donate_argnums=(1, 2, 3, 4, 5, 6)
+            self._chunk_step, donate_argnums=(1, 2, 3, 4, 5, 6, 7)
         )
         self._wave_fn = jax.jit(
-            self._wave_step, donate_argnums=(1, 2, 3, 4, 5, 6)
+            self._wave_step, donate_argnums=(1, 2, 3, 4, 5, 6, 7)
         )
         self._flag_true = jnp.asarray(True)
         self._flag_false = jnp.asarray(False)
@@ -215,14 +219,17 @@ class DeviceBFS:
     # ---------------- device programs ----------------
 
     def _chunk_step(
-        self, frontier, next_buf, jparent, jcand, viol, stats, memo,
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
         cursor, fcount, base_gid, occ, first, *runs,
     ):
         """One chunk of the current wave. stats is i64[6]:
         [wave new count, journal count, cumulative generated,
          cumulative terminal, overflow bits, cumulative canon memo
-         hits]; memo is the [MCAP, 2] canon memo table (threaded through
-        the wave loop, donated); occ is bool[n_levels] (probes of
+        hits]; memo is the [MCAP, 2] canon memo table (threaded through
+        the wave loop, donated); cov is the i64[n_actions, 3] per-action
+        coverage accumulator — [enabled, fired, new-distinct] per Next-
+        disjunct rank, cumulative over the WHOLE run (never reset, so
+        host snapshots are monotone); occ is bool[n_levels] (probes of
         unoccupied levels are skipped via lax.cond); first marks the
         wave's first chunk (resets the wave-new and overflow lanes
         in-program, saving a per-wave host->device stats upload — the
@@ -239,7 +246,7 @@ class DeviceBFS:
         )
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
         live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
-        succs, valid, _rank, ovf = jax.vmap(model._expand1)(batch)
+        succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
         valid = valid & live[:, None]
         expand_ovf = jnp.any(valid & ovf)
         n_gen = jnp.sum(valid)
@@ -293,6 +300,32 @@ class DeviceBFS:
         new = fresh & first
         n_new = jnp.sum(new)
 
+        # 4b. per-action coverage: segment_sum over the rank/valid lanes
+        # _expand1 already returns, invalid lanes routed to drop bucket
+        # K (rank is -1 only where valid is False, so the id stays in
+        # range). enabled counts states where the disjunct's guard held;
+        # fired counts valid candidate lanes; new-distinct counts first-
+        # writer lanes (rank gathered through the compaction `sel`).
+        K = self.n_actions
+        if K:
+            rk = jnp.where(valid, rank, K)
+            fired_k = jax.ops.segment_sum(
+                jnp.ones((C * A,), jnp.int64), rk.reshape(-1),
+                num_segments=K + 1,
+            )[:K]
+            en = (rank[:, :, None] == jnp.arange(K, dtype=rank.dtype)) & (
+                valid[:, :, None]
+            )  # [C, A, K] one-hot (compare beats a scatter on TPU)
+            enabled_k = jnp.sum(jnp.any(en, axis=1), axis=0, dtype=jnp.int64)
+            flat_rk = jnp.concatenate(
+                [rk.reshape(-1), jnp.full((1,), K, rk.dtype)]
+            )[sel]  # [VC] rank per compacted lane (drop row -> bucket K)
+            new_k = jax.ops.segment_sum(
+                new.astype(jnp.int64), jnp.where(new, flat_rk, K),
+                num_segments=K + 1,
+            )[:K]
+            cov = cov + jnp.stack([enabled_k, fired_k, new_k], axis=1)
+
         # 5. scatter into next frontier + journal (row FCAP/JCAP = drop lane)
         ncount = stats[0].astype(jnp.int32)
         jcount = stats[1].astype(jnp.int32)
@@ -340,7 +373,7 @@ class DeviceBFS:
                 stats[5] + n_memo_hit,
             ]
         )
-        return next_buf, jparent, jcand, viol, stats, memo, new_run
+        return next_buf, jparent, jcand, viol, stats, memo, cov, new_run
 
     def _wave_geom(self) -> int:
         """Ladder depth K: levels R0<<0 .. R0<<K, top >= pow2(FCAP), so a
@@ -353,7 +386,7 @@ class DeviceBFS:
         return K
 
     def _wave_step(
-        self, frontier, next_buf, jparent, jcand, viol, stats, memo,
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
         fcount, base_gid, occ, *runs,
     ):
         """One WAVE as a single dispatched program (round 5, verdict Next
@@ -363,8 +396,9 @@ class DeviceBFS:
         and syncs once, instead of paying the tunnel's per-dispatch
         service cost (~100-150 ms after compile activity) per chunk; a
         170-chunk deep wave collapses from ~170 service slots to 1.
-        Returns (next_buf, jparent, jcand, viol, stats, memo, *ladder);
-        the host inserts the occupied ladder levels into the RunLSM."""
+        Returns (next_buf, jparent, jcand, viol, stats, memo, cov,
+        *ladder); the host inserts the occupied ladder levels into the
+        RunLSM."""
         C = self.chunk
         K = self._wave_geom()
         R0 = self.R0
@@ -413,16 +447,17 @@ class DeviceBFS:
             )
 
         def body(carry):
-            k, next_buf, jparent, jcand, viol, stats, memo, *ladder = carry
-            (next_buf, jparent, jcand, viol, stats, memo,
+            (k, next_buf, jparent, jcand, viol, stats, memo, cov,
+             *ladder) = carry
+            (next_buf, jparent, jcand, viol, stats, memo, cov,
              new_run) = self._chunk_step(
-                frontier, next_buf, jparent, jcand, viol, stats, memo,
+                frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
                 k * C, fcount, base_gid, occ_all, jnp.asarray(False),
                 *runs, *ladder,
             )
             ladder = cascade(k, new_run, ladder)
             return (k + 1, next_buf, jparent, jcand, viol, stats, memo,
-                    *ladder)
+                    cov, *ladder)
 
         def cond(carry):
             return carry[0] * C < fcount
@@ -430,7 +465,7 @@ class DeviceBFS:
         out = lax.while_loop(
             cond, body,
             (jnp.int32(0), next_buf, jparent, jcand, viol, stats, memo,
-             *ladder0),
+             cov, *ladder0),
         )
         return out[1:]
 
@@ -466,9 +501,10 @@ class DeviceBFS:
             jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
             viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
             stats = jnp.zeros((6,), jnp.int64)
+            cov = jnp.zeros((self.n_actions, 3), jnp.int64)
             self._wave_fn(
                 frontier, next_buf, jparent, jcand, viol, stats,
-                self._memo.reset(),
+                self._memo.reset(), cov,
                 np.int32(0), np.int32(0), self._occ_one, seen,
             )
             # per-wave seen merges this size can need (targets >= size;
@@ -586,6 +622,13 @@ class DeviceBFS:
             depth_counts = list(ck["depth_counts"])
             stats0 = np.array([0, jcount, gen_prev, terminal, 0, 0],
                               dtype=np.int64)
+            # coverage joined the checkpoint format after version 1
+            # shipped; older files resume with zeroed counters
+            cov_h = (
+                np.asarray(ck["coverage"], dtype=np.int64)
+                if "coverage" in ck.files
+                else np.zeros((self.n_actions, 3), np.int64)
+            )
         else:
             violation = self._check_init(init_d)
             self._seed_seen(np.sort(init_fps[keep]))
@@ -601,6 +644,7 @@ class DeviceBFS:
             depth_counts = [n0]
             gen_prev = 0
             stats0 = np.zeros((6,), dtype=np.int64)
+            cov_h = np.zeros((self.n_actions, 3), np.int64)
 
         # Buffers are allocated ON DEVICE and only the real rows upload:
         # the tunnel moves ~25-35 MB/s, so the round-4 host-built
@@ -625,6 +669,7 @@ class DeviceBFS:
                 (jnp.int32(0),))
         viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
         stats = jnp.asarray(stats0)
+        cov = jnp.asarray(cov_h)  # i64[n_actions, 3], cumulative
         # fresh memo per run: the table is a pure cache (its contents
         # never change a fingerprint), but starting cold keeps
         # back-to-back runs of one engine instance comparable
@@ -654,7 +699,7 @@ class DeviceBFS:
                     self._save_checkpoint(
                         checkpoint_path, frontier, jparent, jcand,
                         fcount, scount, distinct, total, terminal,
-                        depth, base_gid, gen_prev, depth_counts,
+                        depth, base_gid, gen_prev, depth_counts, cov_h,
                     )
                 raise OverflowError(
                     "seen-set capacity overflow; raise max_seen_cap"
@@ -672,7 +717,7 @@ class DeviceBFS:
                 self._save_checkpoint(
                     checkpoint_path, frontier, jparent, jcand, fcount,
                     scount, distinct, total, terminal, depth, base_gid,
-                    gen_prev, depth_counts,
+                    gen_prev, depth_counts, cov_h,
                 )
                 last_ckpt = time.perf_counter()
             tw = time.perf_counter()
@@ -684,16 +729,17 @@ class DeviceBFS:
             with tel.wave_annotation(depth + 1):
                 out = self._wave_fn(
                     frontier, next_buf, jparent, jcand, viol, stats, memo,
-                    np.int32(fcount), np.int32(base_gid),
+                    cov, np.int32(fcount), np.int32(base_gid),
                     self._occ_one, self._seen,
                 )
-                next_buf, jparent, jcand, viol, stats, memo = out[:6]
-                ladder = out[6:]
-                # one host round-trip per wave: stats and the invariant
-                # fold fetched together (two device_gets double the
-                # tunnel RTT on small configs, where per-wave latency
-                # dominates) — and telemetry rides this same snapshot
-                stats_h, viol_h = jax.device_get((stats, viol))
+                next_buf, jparent, jcand, viol, stats, memo, cov = out[:7]
+                ladder = out[7:]
+                # one host round-trip per wave: stats, the invariant
+                # fold and the coverage block fetched together (two
+                # device_gets double the tunnel RTT on small configs,
+                # where per-wave latency dominates) — and telemetry
+                # rides this same snapshot
+                stats_h, viol_h, cov_w = jax.device_get((stats, viol, cov))
             stats_h = np.asarray(stats_h)
             viol_h = np.asarray(viol_h)
             ncount = int(stats_h[0])
@@ -718,6 +764,10 @@ class DeviceBFS:
                     "1=msg-slots 2=valid_per_state 4=frontier_cap 8=journal_cap)"
                     + saved
                 )
+            # the wave completed: adopt its cumulative coverage (the
+            # aborted-wave path above deliberately keeps the wave-start
+            # cov_h, matching the discarded ladder/journal rows)
+            cov_h = np.asarray(cov_w, dtype=np.int64)
             n_gen = int(stats_h[2])
             wave_gen = n_gen - gen_prev
             total += wave_gen
@@ -759,7 +809,7 @@ class DeviceBFS:
                 self._save_checkpoint(
                     checkpoint_path, frontier, jparent, jcand, fcount,
                     scount, distinct, total, terminal, depth, base_gid,
-                    gen_prev, depth_counts,
+                    gen_prev, depth_counts, cov_h,
                 )
                 last_ckpt = time.perf_counter()
             memo_hits = int(stats_h[5])
@@ -788,6 +838,10 @@ class DeviceBFS:
                     "lsm_lanes": int(self._seen.shape[0]),
                 }
                 tel.wave(wm)
+                if tel.active:
+                    tel.coverage(self._coverage_fields(
+                        depth, cov_h, scount, depth_counts,
+                    ))
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -804,7 +858,7 @@ class DeviceBFS:
             self._save_checkpoint(
                 checkpoint_path, frontier, jparent, jcand, fcount,
                 scount, distinct, total, terminal, depth, base_gid,
-                gen_prev, depth_counts,
+                gen_prev, depth_counts, cov_h,
             )
 
         self._jparent = jparent
@@ -816,11 +870,27 @@ class DeviceBFS:
         # table (checker/profile.py)
         self._memo.table = memo
 
+        # canon-memo fill ratio: ONE device reduction, at run end only
+        # (mid-run it would add a per-wave sync), and computed whether or
+        # not telemetry is attached so instrumented and bare runs keep
+        # identical jax.device_get call counts (tests/test_obs.py)
+        if self._use_memo:
+            filled = int(np.asarray(jax.device_get(
+                jnp.sum(ne_u64(memo[:, 0], U64_MAX))
+            )))
+            memo_fill = round(filled / max(1, self.MCAP), 4)
+        else:
+            memo_fill = None
+
         dt = time.perf_counter() - t0
         if violation is not None:
             exit_cause = "violation"
         elif exit_cause is None:
             exit_cause = "exhausted"
+        if tel.active:
+            cf = self._coverage_fields(depth, cov_h, scount, depth_counts)
+            cf["canon_memo_fill"] = memo_fill
+            tel.coverage(cf, final=True)
         tel.close_run({
             "engine": "device",
             "ident": self._ckpt_ident(),
@@ -851,8 +921,28 @@ class DeviceBFS:
             exhausted=exhausted and violation is None,
             trace=trace,
             metrics=metrics,
+            coverage=(
+                [[int(x) for x in row] for row in cov_h]
+                if self.n_actions else None
+            ),
         )
         return res
+
+    def _coverage_fields(self, depth, cov_h, scount, depth_counts) -> dict:
+        """Dedup-structure gauges + the per-action block for a coverage
+        event, all from values the wave loop already holds on host."""
+        return {
+            "depth": depth,
+            "actions": [[int(x) for x in row] for row in cov_h],
+            "actions_total": self.n_actions,
+            "actions_fired": int(np.count_nonzero(cov_h[:, 1]))
+            if self.n_actions else 0,
+            "seen_lanes": [int(self._seen.shape[0])],
+            "seen_real": int(scount),
+            "probe_runs": 1,  # single consolidated seen run (round 5)
+            "frontier_hist": [int(x) for x in depth_counts],
+            "canon_memo_fill": None,  # final snapshot only
+        }
 
     def _telemetry_manifest(self) -> dict:
         """Run-provenance fields of the telemetry manifest event (all
@@ -875,6 +965,7 @@ class DeviceBFS:
             "canon_memo_cap": self.MCAP if self._use_memo else 0,
             "symmetry": bool(self.canon.symmetry),
             "invariants": list(self.invariants),
+            "action_names": list(getattr(self.model, "ACTION_NAMES", ())),
             "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
 
@@ -902,6 +993,7 @@ class DeviceBFS:
     def _save_checkpoint(
         self, path, frontier, jparent, jcand, fcount, scount, distinct,
         total, terminal, depth, base_gid, gen_prev, depth_counts,
+        coverage,
     ):
         """Spill the resumable run state to an .npz (atomic rename).
         Saved at wave boundaries only, so the arrays are consistent."""
@@ -909,11 +1001,13 @@ class DeviceBFS:
             self._write_checkpoint(
                 path, frontier, jparent, jcand, fcount, scount, distinct,
                 total, terminal, depth, base_gid, gen_prev, depth_counts,
+                coverage,
             )
 
     def _write_checkpoint(
         self, path, frontier, jparent, jcand, fcount, scount, distinct,
         total, terminal, depth, base_gid, gen_prev, depth_counts,
+        coverage,
     ):
         import os
 
@@ -942,6 +1036,7 @@ class DeviceBFS:
             base_gid=base_gid,
             gen_prev=gen_prev,
             depth_counts=np.asarray(depth_counts, dtype=np.int64),
+            coverage=np.asarray(coverage, dtype=np.int64),
         )
         os.replace(tmp, path)
 

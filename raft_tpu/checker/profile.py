@@ -300,7 +300,8 @@ def profile_stages(
         viol = jnp.full((max(1, len(invariants)),), np.int32(2**31 - 1), jnp.int32)
         stats = jnp.zeros((6,), jnp.int64)
         memo = jnp.array(m_warm) if use_memo else dev._memo.reset()
-        args = [frontier_d, nb, jp, jc, viol, stats, memo,
+        cov = jnp.zeros((dev.n_actions, 3), jnp.int64)
+        args = [frontier_d, nb, jp, jc, viol, stats, memo, cov,
                 np.int32(0), np.int32(min(fcount, C)), np.int32(0),
                 occ_dev, jnp.asarray(True), *runs]
         jax.block_until_ready(args)

@@ -103,6 +103,26 @@ class Layout:
         return np.zeros(batch + (self.W,), dtype=np.int32)
 
 
+class ActionLabelMixin:
+    """Human-readable labels for expansion candidates, shared by every
+    spec lowering.
+
+    Subclass contract: ``self.bindings`` (the candidate table of
+    ``(kernel_name, binding_tuple)`` pairs) and ``self.ACTION_NAMES``
+    (the Next-disjunct rank -> action-name table; index == the rank
+    that ``_expand1`` reports). Fused ``HandleMessage`` kernels resolve
+    their disjunct at run time, so the label comes from the fired rank;
+    every other kernel is named by its binding."""
+
+    ACTION_NAMES: list[str]
+
+    def action_label(self, rank: int, cand: int) -> str:
+        name, binding = self.bindings[cand]
+        if name == "HandleMessage":
+            return f"{self.ACTION_NAMES[rank]}(slot {binding[0]})"
+        return f"{name}{binding}"
+
+
 def onehot_row(arr, i):
     """``arr[i]`` along axis 0 via a one-hot select.
 

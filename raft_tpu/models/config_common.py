@@ -29,6 +29,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY
+from .base import ActionLabelMixin
 
 # enums shared by both variants (identical values in both specs' lowerings)
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
@@ -73,7 +74,7 @@ PENDING_SNAP_RESPONSE = -2
 R_SENDSNAP, R_HANDLE_SNAPREQ, R_HANDLE_SNAPRESP = 14, 15, 16
 
 
-class ConfigRaftCommon:
+class ConfigRaftCommon(ActionLabelMixin):
     """Mixin with the kernels common to both reconfig lowerings.
 
     Subclass contract: ``self.p`` (params with n_servers/max_log/
@@ -81,17 +82,12 @@ class ConfigRaftCommon:
     ``self.layout``/``self.packer``/``self.n_words``/``self.bindings``,
     layout fields named as in the variants (``config_members``,
     ``log_{n}`` for n in ENTRY_FIELDS, ...), and the three class attrs
-    documented in the module docstring."""
+    documented in the module docstring (``action_label`` itself comes
+    from base.ActionLabelMixin)."""
 
     ENTRY_FIELDS: tuple[str, ...]
     CMD_APPEND: int
     ACTION_NAMES: list[str]
-
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{self.ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
 
     # ---------------- field access helpers ----------------
 

@@ -35,7 +35,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout, messages_are_valid_kernel
+from .base import ActionLabelMixin, Layout, messages_are_valid_kernel
 
 # state[i] enum, shared with oracle/kraft_oracle.py (KRaft.tla:69,87)
 UNATTACHED, VOTED, FOLLOWER, CANDIDATE, LEADER, ILLEGAL = range(6)
@@ -189,10 +189,11 @@ def cached_model(params: "KRaftParams") -> "KRaftModel":
     return _cached_model(params)
 
 
-class KRaftModel:
+class KRaftModel(ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "KRaft"
+    ACTION_NAMES = ACTION_NAMES
     # symmetry: mleader is a nil-valued server field inside packed records
     msg_server_fields = ("msource", "mdest")
     msg_server_nil_fields = ("mleader",)
@@ -246,12 +247,6 @@ class KRaftModel:
                 for v in range(V)
             ],
         }
-
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
 
     # ---------------- field access helpers ----------------
 

@@ -53,7 +53,7 @@ import numpy as np
 from ..ops import bag
 from ..ops.hashing import hash_lanes
 from ..ops.packing import EMPTY, WidePacker, bits_for
-from .base import Layout
+from .base import ActionLabelMixin, Layout
 
 # server states (KRaftWithReconfig.tla:354-360). UNATTACHED = 0 doubles as
 # the all-zero unused-slot filler; every kernel gates on `used`.
@@ -280,10 +280,11 @@ def cached_model(params: "KRaftReconfigParams") -> "KRaftReconfigModel":
     return _cached_model(params)
 
 
-class KRaftReconfigModel:
+class KRaftReconfigModel(ActionLabelMixin):
     """Vectorized successor/invariant kernels for one constants binding."""
 
     name = "KRaftWithReconfig"
+    ACTION_NAMES = ACTION_NAMES
 
     def __init__(self, params: KRaftReconfigParams, server_names=None,
                  value_names=None):
@@ -355,12 +356,6 @@ class KRaftReconfigModel:
 
     def make_canonicalizer(self, symmetry: bool = True, seed: int = 0) -> "SlotCanonicalizer":
         return SlotCanonicalizer(self, symmetry, seed=seed)
-
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
 
     # ---------------- field access helpers ----------------
 

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout, messages_are_valid_kernel
+from .base import ActionLabelMixin, Layout, messages_are_valid_kernel
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 NIL = 0  # leader/votedFor Nil; server i stored as i+1
@@ -160,10 +160,11 @@ def _build_packer(p: PullRaftParams) -> BitPacker:
     )
 
 
-class PullRaftModel:
+class PullRaftModel(ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants)."""
 
     name = "PullRaft"
+    ACTION_NAMES = ACTION_NAMES
 
     def __init__(self, params: PullRaftParams, server_names=None, value_names=None):
         self.p = params
@@ -202,12 +203,6 @@ class PullRaftModel:
             "CommittedEntriesReachMajority": jax.jit(self._inv_committed_majority),
             "TestInv": jax.jit(lambda s: jnp.ones(s.shape[:-1], dtype=bool)),
         }
-
-    def action_label(self, rank: int, cand: int) -> str:
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
 
     # ---------------- helpers ----------------
 

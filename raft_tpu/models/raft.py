@@ -29,7 +29,14 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import Layout, messages_are_valid_kernel, onehot_row, onehot_set, onehot_set2
+from .base import (
+    ActionLabelMixin,
+    Layout,
+    messages_are_valid_kernel,
+    onehot_row,
+    onehot_set,
+    onehot_set2,
+)
 
 # state[i] encoding (CONSTANTS Follower/Candidate/Leader, Raft.tla:38)
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -183,13 +190,18 @@ def cached_model(params: "RaftParams") -> "RaftModel":
     return _cached_model(params)
 
 
-class RaftModel:
+class RaftModel(ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "Raft"
 
     def __init__(self, params: RaftParams, server_names=None, value_names=None):
         self.p = params
+        # Variant-accurate rank table: plain Raft only emits ranks 0..11;
+        # Timeout/AdvanceFsyncIndex (12/13) exist only with has_fsync.
+        self.ACTION_NAMES = (
+            list(ACTION_NAMES) if params.has_fsync else list(ACTION_NAMES[:12])
+        )
         self.layout = _build_layout(params)
         self.packer = _build_packer(params)
         S, V, M = params.n_servers, params.n_values, params.msg_slots
@@ -250,14 +262,6 @@ class RaftModel:
                 for v in range(V)
             ],
         }
-
-    def action_label(self, rank: int, cand: int) -> str:
-        """Human label for candidate `cand` whose fired disjunct was `rank`
-        (fused message-receipt kernels resolve their action at run time)."""
-        name, binding = self.bindings[cand]
-        if name == "HandleMessage":
-            return f"{ACTION_NAMES[rank]}(slot {binding[0]})"
-        return f"{name}{binding}"
 
     # ---------------- field access helpers ----------------
 

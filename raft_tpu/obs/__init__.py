@@ -2,8 +2,9 @@
 
 Live counterpart of the offline stage profiler (checker/profile.py):
 per-wave JSONL metrics (events.py), a TLC-style progress line
-(progress.py), jax.profiler trace hooks (trace.py) and the
-collector/facade threading them through the engines (collector.py).
+(progress.py), jax.profiler trace hooks (trace.py), the
+collector/facade threading them through the engines (collector.py),
+and TLC-style per-action coverage rendering (coverage.py).
 
     from raft_tpu.obs import Telemetry
     tel = Telemetry(metrics_path="m.jsonl", progress_every=10.0)
@@ -12,7 +13,9 @@ collector/facade threading them through the engines (collector.py).
 """
 
 from .collector import MetricsCollector, NULL_TELEMETRY, Telemetry
+from .coverage import coverage_digest, dead_actions, render_coverage_table
 from .events import (
+    COVERAGE_KEYS,
     DECLARED_EVENTS,
     EVENT_KEYS,
     EXIT_CAUSES,
@@ -28,6 +31,7 @@ from .progress import ProgressRenderer, format_count
 from .trace import TraceHooks
 
 __all__ = [
+    "COVERAGE_KEYS",
     "DECLARED_EVENTS",
     "EVENT_KEYS",
     "EXIT_CAUSES",
@@ -40,8 +44,11 @@ __all__ = [
     "ProgressRenderer",
     "Telemetry",
     "TraceHooks",
+    "coverage_digest",
+    "dead_actions",
     "format_count",
     "hashv_of",
+    "render_coverage_table",
     "validate_event",
     "validate_lines",
 ]

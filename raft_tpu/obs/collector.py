@@ -58,6 +58,7 @@ class MetricsCollector:
         self._wave_times: list[float] = []
         self._wave_window = int(stall_window)
         self._last_skipped: dict | None = None
+        self._last_skipped_cov: dict | None = None
         self.stalls = 0
         self.last_summary: dict | None = None
 
@@ -87,6 +88,7 @@ class MetricsCollector:
         self._wave = 0
         self._wave_times = []
         self._last_skipped = None
+        self._last_skipped_cov = None
         self.stalls = 0
         ev = {"event": "manifest", **fields}
         self._write(ev)
@@ -123,12 +125,32 @@ class MetricsCollector:
             self._last_skipped = ev
         self._notify(ev)
 
+    def coverage(self, fields: dict, final: bool = False) -> None:
+        """Cumulative coverage snapshot for the wave just reported (call
+        after ``wave()``; shares its cadence so the JSONL pairs up). The
+        ``final`` snapshot — the engine's end-of-run cumulative totals,
+        the only one carrying the canon-memo fill ratio — always writes
+        and supersedes any cadence-skipped snapshot."""
+        ev = {
+            "event": "coverage", "wave": self._wave, **fields,
+            "final": bool(final),
+        }
+        if final or (self._wave - 1) % self.every == 0 or self._wave == 0:
+            self._write(ev)
+            self._last_skipped_cov = None
+        else:
+            self._last_skipped_cov = ev
+        self._notify(ev)
+
     def summary(self, fields: dict) -> None:
         """Close a run: flush the newest skipped wave (the stream must
         end count-accurate at any cadence), emit the summary event."""
         if self._last_skipped is not None:
             self._write(self._last_skipped)
             self._last_skipped = None
+        if self._last_skipped_cov is not None:
+            self._write(self._last_skipped_cov)
+            self._last_skipped_cov = None
         ev = {
             "event": "summary",
             **fields,
@@ -194,6 +216,9 @@ class Telemetry:
     def wave(self, fields: dict) -> None:
         self.collector.wave(fields)
 
+    def coverage(self, fields: dict, final: bool = False) -> None:
+        self.collector.coverage(fields, final=final)
+
     def close_run(self, summary: dict) -> None:
         self.collector.summary(summary)
 
@@ -215,6 +240,9 @@ class Telemetry:
 
     def wave_events(self) -> list[dict]:
         return self.collector.events_of("wave")
+
+    def coverage_events(self) -> list[dict]:
+        return self.collector.events_of("coverage")
 
     def close(self) -> None:
         self.collector.close()
@@ -240,6 +268,9 @@ class _NullTelemetry:
         pass
 
     def wave(self, fields: dict) -> None:
+        pass
+
+    def coverage(self, fields: dict, final: bool = False) -> None:
         pass
 
     def close_run(self, summary: dict) -> None:

@@ -13,6 +13,14 @@ A run emits one JSON object per line (JSONL), in order:
              occupancy, wall seconds, rolling distinct/s.
   stall      emitted by the wall-clock watchdog when a wave exceeds
              stall_factor x the rolling median wave time.
+  coverage   cumulative state-space cartography at the collector's
+             cadence plus one final snapshot (``final: true``) right
+             before the summary: per-action [enabled, fired,
+             new-distinct] counters (index == the model's ACTION_NAMES
+             rank), seen-set lane occupancy, fingerprint probe depth,
+             frontier depth histogram, canon-memo fill ratio (final
+             snapshot only; null mid-run — reading the memo table
+             mid-run would cost a device sync).
   summary    once per run(), after the last wave: final counts, exit
              cause, peak buffer geometry, fleet memo hit rate.
 
@@ -33,7 +41,7 @@ MANIFEST_KEYS = (
     "event", "engine", "ident", "hashv", "model", "platform", "device",
     "device_count", "chunk", "frontier_cap", "journal_cap",
     "max_seen_cap", "valid_cap", "canon_memo_cap", "symmetry",
-    "invariants", "when",
+    "invariants", "action_names", "when",
 )
 
 WAVE_KEYS = (
@@ -47,6 +55,21 @@ STALL_KEYS = (
     "event", "wave", "depth", "wave_s", "median_wave_s", "factor",
 )
 
+# actions: [n_actions][3] cumulative [enabled, fired, new_distinct]
+# rows, index == the model's ACTION_NAMES rank (manifest carries the
+# names); seen_lanes: allocated seen-set lanes per occupied LSM level
+# (occupancy histogram; the host engine reports one level); seen_real:
+# real (non-padding) fingerprints resident; probe_runs: sorted runs a
+# membership probe binary-searches (fingerprint probe length);
+# frontier_hist: distinct states first seen at each depth 0..d;
+# canon_memo_fill: filled/capacity of the canon memo, null until the
+# final snapshot (and when no memo is configured).
+COVERAGE_KEYS = (
+    "event", "wave", "depth", "actions", "actions_total",
+    "actions_fired", "seen_lanes", "seen_real", "probe_runs",
+    "frontier_hist", "canon_memo_fill", "final",
+)
+
 SUMMARY_KEYS = (
     "event", "engine", "ident", "exit_cause", "violation", "distinct",
     "total", "depth", "terminal", "seconds", "distinct_per_s",
@@ -58,6 +81,7 @@ DECLARED_EVENTS = (
     ("manifest", MANIFEST_KEYS),
     ("wave", WAVE_KEYS),
     ("stall", STALL_KEYS),
+    ("coverage", COVERAGE_KEYS),
     ("summary", SUMMARY_KEYS),
 )
 
@@ -99,6 +123,22 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
             f"{where}summary exit_cause {ev.get('exit_cause')!r} not in "
             f"{EXIT_CAUSES}"
         )
+    if etype == "coverage":
+        acts = ev.get("actions")
+        if not isinstance(acts, list) or any(
+            not isinstance(row, list) or len(row) != 3
+            or any(not isinstance(c, int) or c < 0 for c in row)
+            for row in acts
+        ):
+            problems.append(
+                f"{where}coverage actions must be a list of "
+                f"[enabled, fired, new] non-negative int triples"
+            )
+        elif ev.get("actions_total") != len(acts):
+            problems.append(
+                f"{where}coverage actions_total {ev.get('actions_total')!r}"
+                f" != len(actions) {len(acts)}"
+            )
     return problems
 
 
@@ -109,12 +149,17 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     Structural rules beyond per-event keys: every line must parse; wave
     indices must be strictly increasing within a run (a new manifest
     starts a new run and resets the expectation); a run's summary must
-    come after its waves.
+    come after its waves; coverage events must come before the run's
+    summary, carry non-decreasing wave indices (the final snapshot may
+    repeat the last wave), and their cumulative per-action counters
+    must be monotone non-decreasing cell-by-cell.
     """
     counts: dict[str, int] = {}
     problems: list[str] = []
     last_wave = 0
     summarized = False
+    last_cov_wave = 0
+    prev_actions: list | None = None
     for lineno, raw in enumerate(lines, start=1):
         raw = raw.strip()
         if not raw:
@@ -132,6 +177,35 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
         if etype == "manifest":
             last_wave = 0
             summarized = False
+            last_cov_wave = 0
+            prev_actions = None
+        elif etype == "coverage":
+            if summarized:
+                problems.append(
+                    f"line {lineno}: coverage event after the run's summary"
+                )
+            w = ev.get("wave")
+            if not isinstance(w, int) or w < last_cov_wave:
+                problems.append(
+                    f"line {lineno}: coverage wave index {w!r} not "
+                    f"non-decreasing (previous {last_cov_wave})"
+                )
+            else:
+                last_cov_wave = w
+            acts = ev.get("actions")
+            if isinstance(acts, list) and prev_actions is not None and (
+                len(acts) == len(prev_actions)
+            ):
+                for r, (row, prow) in enumerate(zip(acts, prev_actions)):
+                    if (isinstance(row, list) and isinstance(prow, list)
+                            and len(row) == len(prow) == 3
+                            and any(c < p for c, p in zip(row, prow))):
+                        problems.append(
+                            f"line {lineno}: coverage counters for action "
+                            f"rank {r} not monotone ({prow} -> {row})"
+                        )
+            if isinstance(acts, list):
+                prev_actions = acts
         elif etype == "wave":
             if summarized:
                 problems.append(

@@ -90,6 +90,9 @@ class ShardedResult:
     # fleet aggregates: canon-memo hits/rate summed over shards plus
     # per-shard skew (always populated; cheap host arithmetic)
     stats: dict | None = None
+    # fleet-summed per-action [enabled, fired, new-distinct] in
+    # ACTION_NAMES rank order; None for models without the contract
+    coverage: list[list[int]] | None = None
 
 
 class ShardedBFS:
@@ -129,6 +132,9 @@ class ShardedBFS:
     ):
         self.model = model
         self.invariants = tuple(invariants)
+        # rank-indexed coverage rows; 0 for models without the
+        # ACTION_NAMES contract (coverage then disabled)
+        self.n_actions = len(getattr(model, "ACTION_NAMES", ()))
         devices = devices if devices is not None else jax.devices()
         self.D = len(devices)
         # the u32-decomposed fp%D owner routing is exact only for D<=2^16
@@ -219,47 +225,66 @@ class ShardedBFS:
                 _shard_map(
                     self._chunk_step,
                     mesh=self.mesh,
-                    in_specs=(spec,) * 9 + (P(), P(), spec) + (spec,) * n_runs,
-                    out_specs=(spec,) * 8,
+                    in_specs=(spec,) * 10 + (P(), P(), spec) + (spec,) * n_runs,
+                    out_specs=(spec,) * 9,
                     **_SHARD_MAP_KW,
                 ),
-                # donated: next_buf, jps, jpl, jcand, viol, stats, memo
-                donate_argnums=(2, 3, 4, 5, 6, 7, 8),
+                # donated: next_buf, jps, jpl, jcand, viol, stats, memo, cov
+                donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9),
             )
             self._chunk_fn_cache[n_runs] = fn
         return fn
 
     def _chunk_step(
         self, frontier, fcount, next_buf, jps, jpl, jcand, viol, stats,
-        memo, cursor, occ, base_lgid, *runs,
+        memo, cov, cursor, occ, base_lgid, *runs,
     ):
         """One chunk of the current wave on one chip.
 
         frontier [1,F+1,W]; fcount/base_lgid [1,1]; next_buf [1,F+1,W];
         jps/jpl/jcand [1,JC+1]; viol [1,K]; occ bool[L] (replicated);
         runs: L sharded [1,lanes] sorted u64; memo [1,MCAP,2] shard-local
-        canon memo; stats [1,S] i64 = [wave new, jcount, cum generated,
+        canon memo; cov [1,n_actions,3] i64 per-shard cumulative
+        [enabled, fired, new] per action rank (enabled/fired tally on the
+        GENERATING chip, new on the OWNER chip after the all-to-all);
+        stats [1,S] i64 = [wave new, jcount, cum generated,
         cum terminal, ovf bits, routed lanes, cum canon memo hits].
         Returns (+ new_run [1,R0]).
         """
         model, D, A, W = self.model, self.D, self.A, self.W
         C, VC, RC = self.chunk, self.VC, self.RC
         F, JC = self.FCAP, self.JCAP
+        K = self.n_actions
         # strip the leading local-block axis shard_map hands us
         frontier, fcount, base_lgid = frontier[0], fcount[0, 0], base_lgid[0, 0]
         next_buf = next_buf[0]
         jps, jpl, jcand, viol, stats = jps[0], jpl[0], jcand[0], viol[0], stats[0]
         memo = memo[0]
+        cov = cov[0]
         runs = [r[0] for r in runs]
 
         # 1. expand `chunk` rows starting at the wave cursor
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
         live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
-        succs, valid, _rank, ovf = jax.vmap(model._expand1)(batch)
+        succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
         valid = valid & live[:, None]
         expand_ovf = jnp.any(valid & ovf)
         n_gen = jnp.sum(valid)
         term = jnp.sum(live & ~jnp.any(valid, axis=1))
+
+        # 1b. enabled/fired per action rank, tallied where the lanes are
+        # generated (numpy mirror in checker/bfs.py; invalid lanes route
+        # to drop bucket K)
+        if K:
+            rk = jnp.where(valid, rank, K)
+            fired_k = jax.ops.segment_sum(
+                jnp.ones((C * A,), jnp.int64), rk.reshape(-1),
+                num_segments=K + 1,
+            )[:K]
+            en = (rank[:, :, None] == jnp.arange(K, dtype=rank.dtype)) & (
+                valid[:, :, None]
+            )  # [C, A, K] one-hot (compare beats a scatter on TPU)
+            enabled_k = jnp.sum(jnp.any(en, axis=1), axis=0, dtype=jnp.int64)
 
         # 2. compact the valid lanes (sel[j] = flat lane of the j-th valid)
         vflat = valid.reshape(-1)
@@ -291,10 +316,16 @@ class ShardedBFS:
             fps = jnp.where(selv, fps, U64_MAX)
             n_memo_hit = jnp.asarray(0, jnp.int32)
 
-        # 4. route to owner chip = fp mod D: sort by owner, positional slots
+        # 4. route to owner chip = fp mod D: sort by owner, positional
+        # slots. The action rank rides the payload so the OWNER chip can
+        # attribute new-distinct states per action after dedup.
+        lane_rank = jnp.concatenate(
+            [rank.reshape(-1), jnp.full((1,), -1, rank.dtype)]
+        )[sel]  # [VC] rank per compacted lane (drop row -> -1)
         payload = jnp.concatenate(
-            [flatc, parent_lgid[:, None], cand[:, None]], axis=1
-        )  # [VC, W+2] i32
+            [flatc, parent_lgid[:, None], cand[:, None],
+             lane_rank[:, None].astype(jnp.int32)], axis=1
+        )  # [VC, W+3] i32
         # fp mod D in u32 pieces (u64 div/mod lanes are slow on this TPU):
         # (hi*2^32 + lo) % D == ((hi%D) * (2^32%D) + lo%D) % D
         # exact only while (D-1)*(2^32%D) + (D-1) fits u32 — enforced at
@@ -313,7 +344,7 @@ class ShardedBFS:
         route_ovf = jnp.any((owner_s < D) & (pos_in_owner >= RC))
         n_routed = jnp.sum(ok)
         slot = jnp.where(ok, owner_s * RC + pos_in_owner, D * RC)
-        send_pay = jnp.zeros((D * RC + 1, W + 2), jnp.int32).at[slot].set(payload[order])[:-1]
+        send_pay = jnp.zeros((D * RC + 1, W + 3), jnp.int32).at[slot].set(payload[order])[:-1]
         send_fps = jnp.full((D * RC + 1,), U64_MAX, jnp.uint64).at[slot].set(
             jnp.where(ok, fps_s, U64_MAX))[:-1]
 
@@ -353,6 +384,16 @@ class ShardedBFS:
         jps = jps.at[jdst].set((sidx // RC).astype(jnp.int32))
         jpl = jpl.at[jdst].set(recv_pay[sidx, W])
         jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
+        if K:
+            # new-distinct per rank on the owner chip (non-new lanes ->
+            # drop bucket K; their routed rank column may be garbage 0s
+            # from unfilled send slots, but `new` masks them out)
+            recv_rank = recv_pay[sidx, W + 2]
+            new_k = jax.ops.segment_sum(
+                new.astype(jnp.int64), jnp.where(new, recv_rank, K),
+                num_segments=K + 1,
+            )[:K]
+            cov = cov + jnp.stack([enabled_k, fired_k, new_k], axis=1)
         # the chip's new fps as one sorted run (LSM level-0 insert)
         new_run = sort_u64(jnp.where(new, rf, U64_MAX))
         DRC = new_run.shape[0]
@@ -388,7 +429,7 @@ class ShardedBFS:
         )
         return (
             next_buf[None], jps[None], jpl[None], jcand[None], viol[None],
-            stats[None], memo[None], new_run[None],
+            stats[None], memo[None], cov[None], new_run[None],
         )
 
     # ---------------- capacity growth (between waves, host-mediated) ------
@@ -441,6 +482,7 @@ class ShardedBFS:
     def _save_checkpoint(
         self, path, state, fcounts, scounts, jcounts, n0, base_lgid,
         distinct, total, terminal, depth, gen_prev, routed_prev, depth_counts,
+        coverage,
     ):
         import os
 
@@ -475,6 +517,7 @@ class ShardedBFS:
             distinct=distinct, total=total, terminal=terminal, depth=depth,
             gen_prev=gen_prev, routed_prev=routed_prev,
             depth_counts=np.asarray(depth_counts, dtype=np.int64),
+            coverage=np.asarray(coverage, dtype=np.int64),
         )
         os.replace(tmp, path)
 
@@ -557,6 +600,12 @@ class ShardedBFS:
             gen_prev = int(ck["gen_prev"])
             routed_prev = int(ck["routed_prev"])
             depth_counts = list(ck["depth_counts"])
+            # pre-coverage checkpoints resume with zeroed counters
+            cov_hd = (
+                np.asarray(ck["coverage"], dtype=np.int64)
+                if "coverage" in ck.files
+                else np.zeros((D, self.n_actions, 3), np.int64)
+            )
             # per-shard generated/terminal/routed cums are not persisted
             # per shard; resume them as deltas from zero and add the saved
             # totals back via the *_base offsets
@@ -624,6 +673,7 @@ class ShardedBFS:
             routed_prev = 0
             depth = 0
             depth_counts = [distinct]
+            cov_hd = np.zeros((D, self.n_actions, 3), np.int64)
 
         tel.open_run(self._telemetry_manifest())
         metrics: list[dict] | None = [] if collect_metrics else None
@@ -631,6 +681,7 @@ class ShardedBFS:
         # fresh per-shard memo per run: a pure cache, but starting empty
         # keeps consecutive runs of one engine byte-reproducible
         state["memo"] = self._memo.reset()
+        state["cov"] = jax.device_put(cov_hd, self._sharding)
         memo_prev = 0
         per_shard_memo = np.zeros(D, np.int64)
 
@@ -655,7 +706,7 @@ class ShardedBFS:
                         jcounts, n0, base_lgid, distinct, total,
                         terminal + term_base, depth,
                         gen_prev + gen_base, routed_prev + routed_base,
-                        depth_counts,
+                        depth_counts, cov_hd,
                     )
                 raise OverflowError(
                     "sharded seen-set capacity overflow; raise max_seen_cap"
@@ -673,17 +724,20 @@ class ShardedBFS:
                     chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
                     (state["next_buf"], state["jps"], state["jpl"],
                      state["jcand"], state["viol"], state["stats"],
-                     state["memo"], new_run,
+                     state["memo"], state["cov"], new_run,
                      ) = chunk_fn(
                         state["frontier"], fc_dev, state["next_buf"],
                         state["jps"], state["jpl"], state["jcand"],
                         state["viol"], state["stats"], state["memo"],
-                        np.int32(cursor), occ_dev, bl_dev, *self._lsm.runs,
+                        state["cov"], np.int32(cursor), occ_dev, bl_dev,
+                        *self._lsm.runs,
                     )
                     self._lsm.insert(new_run)
                     chunks_done += 1
-                stats_h, viol_h = jax.device_get(
-                    (state["stats"], state["viol"]))
+                # cov rides the same once-per-wave fetch — no extra
+                # device_get calls with coverage on
+                stats_h, viol_h, cov_w = jax.device_get(
+                    (state["stats"], state["viol"], state["cov"]))
             stats_h = np.asarray(stats_h)  # [D,7]
             viol_h = np.asarray(viol_h)  # [D,K]
             new_d = stats_h[:, 0]
@@ -693,6 +747,9 @@ class ShardedBFS:
                     f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
                     "1=msg-slots 2=valid_per_state 4=route_cap "
                     "8=frontier_cap 16=journal_cap)")
+            # commit only after the ovf check: an aborted wave keeps the
+            # wave-start counters (consistent with what a checkpoint saved)
+            cov_hd = np.asarray(cov_w, dtype=np.int64)
             global_new = int(new_d.sum())
             n_gen_cum = int(stats_h[:, 2].sum())
             wave_gen = n_gen_cum - gen_prev
@@ -750,6 +807,7 @@ class ShardedBFS:
                             terminal + term_base, depth,
                             gen_prev + gen_base,
                             routed_prev + routed_base, depth_counts,
+                            cov_hd,
                         )
                     last_ckpt = time.perf_counter()
             if tel.active or metrics is not None or verbose:
@@ -772,7 +830,8 @@ class ShardedBFS:
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
                     "a2a_lanes": wave_routed,
-                    "a2a_bytes": wave_routed * (4 * (W + 2) + 8),
+                    # payload widened to W+3 by the routed rank column
+                    "a2a_bytes": wave_routed * (4 * (W + 3) + 8),
                     "shard_new": [int(x) for x in new_d],
                     "shard_new_min": int(new_d.min()),
                     "shard_new_max": int(new_d.max()),
@@ -780,6 +839,9 @@ class ShardedBFS:
                     "lsm_lanes": int(self._lsm.lanes()),
                 }
                 tel.wave(wm)
+                if tel.active:
+                    tel.coverage(self._coverage_fields(
+                        depth, cov_hd, scounts, depth_counts))
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -796,6 +858,7 @@ class ShardedBFS:
                 checkpoint_path, state, fcounts, scounts, jcounts, n0,
                 base_lgid, distinct, total, terminal + term_base, depth,
                 gen_prev + gen_base, routed_prev + routed_base, depth_counts,
+                cov_hd,
             )
 
         # fetch journals for trace reconstruction
@@ -813,6 +876,7 @@ class ShardedBFS:
         # totals + per-shard skew, from the SAME host stats the loop
         # already fetched — also returned on ShardedResult.stats
         fleet_rate = round(memo_prev / max(1, gen_prev), 4)
+        fleet_cov = cov_hd.sum(axis=0)
         fleet_stats = {
             "canon_memo_hits": memo_prev,
             "canon_memo_hit_rate": fleet_rate,
@@ -820,7 +884,22 @@ class ShardedBFS:
             "shard_distinct": [int(x) for x in scounts],
             "shard_skew": round(
                 int(scounts.max()) / max(1, int(scounts.min())), 3),
+            "coverage": [[int(x) for x in row] for row in fleet_cov],
         }
+        # final canon-memo fill ratio: one device reduction, done whether
+        # or not telemetry is attached so the zero-sync guarantee (equal
+        # device_get call counts) holds either way
+        if self._use_memo:
+            filled = int(np.asarray(jax.device_get(
+                jnp.sum(ne_u64(state["memo"][:, :, 0], U64_MAX))
+            )))
+            memo_fill = round(filled / max(1, self.D * self.MCAP), 4)
+        else:
+            memo_fill = None
+        if tel.active:
+            cf = self._coverage_fields(depth, cov_hd, scounts, depth_counts)
+            cf["canon_memo_fill"] = memo_fill
+            tel.coverage(cf, final=True)
         tel.close_run({
             "engine": "sharded",
             "ident": self._ckpt_ident(),
@@ -857,7 +936,29 @@ class ShardedBFS:
             trace=trace,
             metrics=metrics,
             stats=fleet_stats,
+            coverage=(fleet_stats["coverage"] if self.n_actions else None),
         )
+
+    def _coverage_fields(self, depth, cov_hd, scounts, depth_counts) -> dict:
+        """Coverage-event payload (obs.events.COVERAGE_KEYS), fleet-summed
+        from the per-shard [D, n_actions, 3] counters. Dedup gauges come
+        from the shared LSM geometry (identical on every chip)."""
+        fleet = cov_hd.sum(axis=0)
+        occ = list(self._lsm.occ)
+        return {
+            "depth": depth,
+            "actions": [[int(x) for x in row] for row in fleet],
+            "actions_total": self.n_actions,
+            "actions_fired": int(np.count_nonzero(fleet[:, 1]))
+            if self.n_actions else 0,
+            "seen_lanes": [
+                int(r.shape[-1]) for r, o in zip(self._lsm.runs, occ) if o
+            ],
+            "seen_real": int(scounts.sum()),
+            "probe_runs": int(sum(occ)),
+            "frontier_hist": [int(x) for x in depth_counts],
+            "canon_memo_fill": None,  # final snapshot only
+        }
 
     def _telemetry_manifest(self) -> dict:
         """Run-provenance fields of the telemetry manifest event."""
@@ -879,6 +980,7 @@ class ShardedBFS:
             "canon_memo_cap": self.MCAP if self._use_memo else 0,
             "symmetry": bool(self.canon.symmetry),
             "invariants": list(self.invariants),
+            "action_names": list(getattr(self.model, "ACTION_NAMES", ())),
             "when": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
 

@@ -47,6 +47,19 @@ def manifest_fields(tel) -> dict:
              "chunk")}
 
 
+def coverage_fields(model, res) -> dict | None:
+    """Action-coverage digest for a deep-run provenance block: actions
+    fired / total and the least-covered action, so a throughput number
+    also says how much of the spec's Next relation the run exercised."""
+    from raft_tpu.obs import coverage_digest
+
+    cov = getattr(res, "coverage", None)
+    names = getattr(model, "ACTION_NAMES", None)
+    if cov is None or not names:
+        return None
+    return coverage_digest(names, cov)
+
+
 def gate(model, invs, depth, chunks=(1024, 2048), **caps):
     from raft_tpu.checker.parity import parity_gate
 
@@ -98,6 +111,7 @@ def cmp_and_deep(model, invs, oracle, cmp_depth, chunk=2048,
             "seconds": round(deep.seconds, 2),
             "distinct_per_s": round(deep.states_per_sec, 1),
             "violation": deep.violation.invariant if deep.violation else None,
+            "coverage": coverage_fields(model, deep),
         },
     }
 
@@ -137,6 +151,7 @@ def row2():
         "seconds": round(deep.seconds, 2),
         "sustained_distinct_per_s": round(deep.states_per_sec, 1),
         "final_wave": last,
+        "coverage": coverage_fields(model, deep),
     }
     return out
 
@@ -218,6 +233,7 @@ def row5():
         "seconds": round(deep.seconds, 2),
         "distinct_per_s": round(deep.states_per_sec, 1),
         "violation": deep.violation.invariant if deep.violation else None,
+        "coverage": coverage_fields(setup.model, deep),
     }
     return out
 

@@ -7,8 +7,13 @@ Checks every line against raft_tpu.obs.events.DECLARED_EVENTS (the same
 tuple the tier-1 smoke test pins): valid JSON per line, known event
 type, every declared key present, wave indices strictly increasing
 within a run, no wave after a run's summary, and a legal exit_cause on
-each summary. Exit status 0 iff every file is clean — bench.py runs
-this after each telemetry-enabled run.
+each summary. Coverage events get the structural checks on top: the
+actions block must be [enabled, fired, new] non-negative int triples
+matching actions_total, coverage must come before the run's summary
+with non-decreasing wave indices, and the cumulative per-action
+counters must be monotone non-decreasing cell by cell across the
+stream. Exit status 0 iff every file is clean — bench.py runs this
+after each telemetry-enabled run.
 
 Dependency-free on purpose (no jax/numpy import happens): schema
 validation must work on a machine with nothing but the repo checked

@@ -70,8 +70,9 @@ def main():
                             np.int32(2**31 - 1), jnp.int32)
             stats = jnp.zeros((6,), jnp.int64)
             memo = dev._memo.reset()
-            return [frontier, nb, jp, jc, viol, stats, memo, np.int32(0),
-                    np.int32(min(n, C)), np.int32(0), occ_dev,
+            cov = jnp.zeros((dev.n_actions, 3), jnp.int64)
+            return [frontier, nb, jp, jc, viol, stats, memo, cov,
+                    np.int32(0), np.int32(min(n, C)), np.int32(0), occ_dev,
                     jnp.asarray(True), *runs]
 
         t0 = time.perf_counter()

@@ -1,0 +1,212 @@
+"""Offline telemetry digest: JSONL event stream -> Markdown report.
+
+Renders a run recorded with ``--metrics-out`` (see raft_tpu/obs) into a
+human-readable digest: manifest provenance, the summary block, the
+TLC-style per-action coverage table, the frontier depth histogram, an
+occupancy sparkline over waves, and any stall events.
+
+Deliberately dependency-free (stdlib only — no jax, no numpy, no
+raft_tpu import): the report renders on any machine the JSONL file is
+copied to, including ones without the accelerator toolchain.
+
+Usage:
+    python scripts/obs_report.py run.jsonl [--all] [--out report.md]
+
+By default only the LAST run in the file is reported (a stream may hold
+several; each ``manifest`` event starts a new run); --all reports every
+run in order.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SPARK = "▁▂▃▄▅▆▇█"
+BAR_WIDTH = 40
+
+
+def sparkline(values) -> str:
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK[0] * len(vals)
+    return "".join(
+        SPARK[min(len(SPARK) - 1, int((v - lo) / (hi - lo) * len(SPARK)))]
+        for v in vals
+    )
+
+
+def hbar(value: int, peak: int) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1 if value else 0, round(value / peak * BAR_WIDTH))
+
+
+def split_runs(lines) -> list[list[dict]]:
+    """Group decoded events into runs; a manifest starts a new run."""
+    runs: list[list[dict]] = []
+    for raw in lines:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            ev = json.loads(raw)
+        except ValueError:
+            continue
+        if not isinstance(ev, dict) or "event" not in ev:
+            continue
+        if ev["event"] == "manifest" or not runs:
+            runs.append([])
+        runs[-1].append(ev)
+    return runs
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def render_run(events: list[dict]) -> str:
+    man = next((e for e in events if e["event"] == "manifest"), {})
+    summ = next((e for e in events if e["event"] == "summary"), None)
+    waves = [e for e in events if e["event"] == "wave"]
+    stalls = [e for e in events if e["event"] == "stall"]
+    covs = [e for e in events if e["event"] == "coverage"]
+    cov = covs[-1] if covs else None
+    names = man.get("action_names") or []
+
+    out = []
+    title = man.get("model", "unknown model")
+    out.append(f"# Telemetry report: {title} ({man.get('engine', '?')})")
+    out.append("")
+    for k in ("ident", "platform", "device", "device_count", "chunk",
+              "symmetry", "invariants", "when"):
+        if k in man:
+            out.append(f"- **{k}**: {_fmt(man[k])}")
+    out.append("")
+
+    out.append("## Summary")
+    out.append("")
+    if summ is None:
+        out.append("_no summary event — the run did not finish cleanly_")
+    else:
+        for k in ("exit_cause", "violation", "distinct", "total", "depth",
+                  "terminal", "seconds", "distinct_per_s", "exhausted",
+                  "waves", "stalls", "canon_memo_hit_rate"):
+            if k in summ:
+                out.append(f"- **{k}**: {_fmt(summ[k])}")
+    out.append("")
+
+    out.append("## Action coverage")
+    out.append("")
+    if cov is None or not cov.get("actions"):
+        out.append("_no coverage events in the stream_")
+    else:
+        acts = cov["actions"]
+        out.append("| action | enabled | fired | new distinct |")
+        out.append("|---|---:|---:|---:|")
+        dead = []
+        for r, row in enumerate(acts):
+            name = names[r] if r < len(names) else f"action[{r}]"
+            e, f, n = int(row[0]), int(row[1]), int(row[2])
+            out.append(f"| {name} | {e} | {f} | {n} |")
+            if f == 0:
+                dead.append(name)
+        out.append("")
+        out.append(
+            f"{cov.get('actions_fired', 0)}/{cov.get('actions_total', 0)} "
+            f"actions fired"
+            + (f"; canon memo fill {cov['canon_memo_fill']}"
+               if cov.get("canon_memo_fill") is not None else "")
+        )
+        for name in dead:
+            out.append(f"- **WARNING**: action {name} never fired")
+    out.append("")
+
+    out.append("## Depth histogram")
+    out.append("")
+    hist = (cov or {}).get("frontier_hist") or []
+    if not hist:
+        out.append("_no frontier histogram recorded_")
+    else:
+        peak = max(int(x) for x in hist)
+        out.append("```")
+        for d, x in enumerate(hist):
+            out.append(f"depth {d:>3}  {int(x):>10}  {hbar(int(x), peak)}")
+        out.append("```")
+    out.append("")
+
+    out.append("## Wave profile")
+    out.append("")
+    if not waves:
+        out.append("_no wave events in the stream_")
+    else:
+        out.append(f"- new distinct/wave:  `{sparkline([w['new'] for w in waves])}`")
+        out.append(f"- wave seconds:       `{sparkline([w['wave_s'] for w in waves])}`")
+        out.append(
+            f"- seen-lane occupancy: `{sparkline([w['lsm_lanes'] for w in waves])}`"
+            f" (last: {waves[-1]['lsm_lanes']} lanes in "
+            f"{waves[-1]['lsm_runs']} runs)"
+        )
+        if cov is not None and cov.get("seen_lanes"):
+            out.append(
+                f"- final seen runs: {cov.get('probe_runs')} "
+                f"(lanes per run: {cov['seen_lanes']}; "
+                f"real fingerprints: {cov.get('seen_real')})"
+            )
+    out.append("")
+
+    out.append("## Stalls")
+    out.append("")
+    if not stalls:
+        out.append("_none_")
+    else:
+        for s in stalls:
+            out.append(
+                f"- wave {s.get('wave')} (depth {s.get('depth')}): "
+                f"{_fmt(s.get('wave_s'))}s vs median "
+                f"{_fmt(s.get('median_wave_s'))}s "
+                f"({_fmt(s.get('factor'))}x)"
+            )
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_report",
+        description="Render a telemetry JSONL stream as a Markdown digest.",
+    )
+    ap.add_argument("path", help="JSONL file written via --metrics-out")
+    ap.add_argument("--all", action="store_true",
+                    help="report every run in the file (default: last only)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    with open(args.path) as fh:
+        runs = split_runs(fh)
+    if not runs:
+        print(f"error: no telemetry events in {args.path}", file=sys.stderr)
+        return 1
+    picked = runs if args.all else runs[-1:]
+    text = "\n---\n\n".join(render_run(r) for r in picked)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    else:
+        try:
+            print(text)
+        except BrokenPipeError:  # | head — truncated output is the ask
+            sys.stderr.close()
+            return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,8 @@ def test_device_metrics_stream_valid_and_count_accurate(tmp_path):
     assert not problems, problems
     assert counts["manifest"] == 1 and counts["summary"] == 1
     assert counts["wave"] >= 4  # >= depth-many wave events
+    # coverage pairs with each wave plus one final snapshot
+    assert counts["coverage"] == counts["wave"] + 1
 
     events = [json.loads(ln) for ln in lines]
     assert events[0]["event"] == "manifest"
@@ -135,6 +137,13 @@ def test_telemetry_adds_zero_device_syncs_and_is_bit_identical(monkeypatch):
     assert instrumented.total == bare.total
     assert instrumented.terminal == bare.terminal
     assert len(tel.wave_events()) >= 4
+    # the per-action coverage block rides the same snapshot: present,
+    # bit-identical with telemetry on/off, final event mirrors it
+    assert bare.coverage is not None
+    assert instrumented.coverage == bare.coverage
+    covs = tel.coverage_events()
+    assert covs and covs[-1]["final"] is True
+    assert covs[-1]["actions"] == instrumented.coverage
 
 
 # -------------------------------------------------------------- watchdog
@@ -188,7 +197,7 @@ def test_watchdog_flags_stall_against_prior_median():
 def test_schema_and_renderer_stay_in_sync():
     # the contract check_metrics_schema.py and the engines share
     assert tuple(n for n, _ in DECLARED_EVENTS) == (
-        "manifest", "wave", "stall", "summary",
+        "manifest", "wave", "stall", "coverage", "summary",
     )
     for _, keys in DECLARED_EVENTS:
         assert keys[0] == "event"
@@ -325,10 +334,15 @@ def test_sharded_stream_and_fleet_stats(tmp_path):
     # returned result
     assert res.stats is not None
     for k in ("canon_memo_hits", "canon_memo_hit_rate", "shard_memo_hits",
-              "shard_distinct", "shard_skew"):
+              "shard_distinct", "shard_skew", "coverage"):
         assert k in res.stats, k
     assert len(res.stats["shard_memo_hits"]) == 4
     assert sum(res.stats["shard_distinct"]) == res.distinct
+    # fleet-summed coverage: one row per action, new sums to distinct
+    # beyond the inits
+    assert res.coverage == res.stats["coverage"]
+    assert len(res.coverage) == len(cached_model(SMALL).ACTION_NAMES)
+    assert sum(r[2] for r in res.coverage) == res.distinct - res.depth_counts[0]
     assert res.stats["shard_skew"] >= 1.0
     assert tel.last_summary["canon_memo_hit_rate"] == res.stats[
         "canon_memo_hit_rate"
