@@ -54,6 +54,30 @@ def _setup_or_fallback():
             "builtin raft3 (no /root/reference checkout)")
 
 
+def _emit_micro_summary():
+    """Digest of EMIT_MICRO.json (scripts/emit_micro.py) when present:
+    the measured emit-strategy costs the round-6 append emit rests on,
+    attached to the benchmark's provenance so the rate number carries
+    the evidence for its emit path. None when the microbench has not
+    been run on this checkout."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "EMIT_MICRO.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        em = json.load(f)
+    worst = max(em["rows"], key=lambda r: r["scatter_over_compact"])
+    return {
+        "device": em["meta"]["device"],
+        "when": em["meta"]["when"],
+        "cells": len(em["rows"]),
+        "worst_scatter_over_compact": worst["scatter_over_compact"],
+        "worst_cell": {k: worst[k] for k in
+                       ("vc", "fcap", "scatter_full_ms", "compact_dus_ms",
+                        "sort_emit_ms")},
+    }
+
+
 def repro_main():
     """--repro: two consecutive IN-PROCESS deep runs after one
     precompile, both sustained rates recorded — the reproducibility
@@ -297,6 +321,7 @@ def main():
             },
             "exit_cause": deep_summary.get("exit_cause"),
             "canon_memo_hit_rate": deep_summary.get("canon_memo_hit_rate"),
+            "emit_micro": _emit_micro_summary(),
             "metrics_file": {
                 "path": metrics_path,
                 "schema_ok": not metrics_problems,

@@ -46,6 +46,45 @@ def _merge_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out
 
 
+class _AppendBuf:
+    """Amortized-doubling cursor-append buffer: the host mirror of the
+    device engines' contiguous emit (checker/util.py emit_append). Each
+    chunk's survivors land at a running cursor in one contiguous copy,
+    replacing the per-wave list-of-arrays + concatenate (which held every
+    chunk's fragment live and re-walked them all at wave end)."""
+
+    def __init__(self, cols: int | None, dtype):
+        self.n = 0
+        self._cols = cols
+        self._buf = np.empty((0,) if cols is None else (0, cols), dtype)
+
+    def append(self, rows: np.ndarray) -> None:
+        need = self.n + len(rows)
+        if need > len(self._buf):
+            cap = max(1024, len(self._buf))
+            while cap < need:
+                cap *= 2
+            grown = np.empty(
+                (cap,) if self._cols is None else (cap, self._cols),
+                self._buf.dtype,
+            )
+            grown[: self.n] = self._buf[: self.n]
+            self._buf = grown
+        self._buf[self.n : need] = rows
+        self.n = need
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes of REAL rows (the emit-bytes gauge counts written data,
+        not the doubling headroom)."""
+        return self._buf[: self.n].nbytes
+
+    def take(self) -> np.ndarray:
+        """The real rows as an owning array (drops the headroom, so a
+        wave's frontier does not pin the oversized append buffer)."""
+        return self._buf[: self.n].copy()
+
+
 @dataclass
 class Violation:
     invariant: str
@@ -150,9 +189,11 @@ class BFSChecker:
                 exit_cause = "time_budget"
                 break
             tw = time.perf_counter()
-            new_states: list[np.ndarray] = []
-            new_parents: list[np.ndarray] = []
-            new_cands: list[np.ndarray] = []
+            # contiguous cursor-append emit (mirrors the device engines'
+            # emit_append): survivors append at a running cursor
+            wave_sb = _AppendBuf(model.layout.W, np.int32)
+            wave_pb = _AppendBuf(None, np.int64)
+            wave_cb = _AppendBuf(None, np.int32)
             # fingerprints first discovered this wave; kept separate from the
             # (much larger) global seen-set so per-chunk dedup only re-sorts
             # wave-sized arrays
@@ -208,19 +249,20 @@ class BFSChecker:
                             flat_rk[idx], minlength=K + 1)[:K]
                     if len(idx):
                         sel = np.asarray(jax.device_get(flat[idx]))
-                        new_states.append(sel)
-                        new_parents.append(base_gid + off + idx // model.A)
-                        new_cands.append((idx % model.A).astype(np.int32))
+                        wave_sb.append(sel)
+                        wave_pb.append(base_gid + off + idx // model.A)
+                        wave_cb.append((idx % model.A).astype(np.int32))
                         wave_fps = np.sort(np.concatenate([wave_fps, fps[idx]]))
 
             total += n_cand_total
             terminal += int((~has_succ).sum())
-            if not new_states:
+            if wave_sb.n == 0:
                 exit_cause = "exhausted"
                 break
-            wave_states = np.concatenate(new_states, axis=0)
-            wave_parents = np.concatenate(new_parents)
-            wave_cands = np.concatenate(new_cands)
+            emit_bytes = wave_sb.nbytes + wave_pb.nbytes + wave_cb.nbytes
+            wave_states = wave_sb.take()
+            wave_parents = wave_pb.take()
+            wave_cands = wave_cb.take()
             self._parents.append(wave_parents)
             self._cands.append(wave_cands)
             with tel.annotate("seen_merge"):
@@ -252,6 +294,12 @@ class BFSChecker:
                     "overflow_bits": 0,
                     "lsm_runs": 1,
                     "lsm_lanes": int(len(seen)),
+                    # emit gauges (round 6): rows/bytes the cursor-append
+                    # emit wrote this wave; the host engine has no fixed-
+                    # capacity frontier buffer, so fill is reported as 0
+                    "emit_rows": len(wave_states),
+                    "emit_bytes": emit_bytes,
+                    "frontier_fill": 0.0,
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),

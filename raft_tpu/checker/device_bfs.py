@@ -12,8 +12,11 @@ Pipeline per chunk (one jitted program, all device):
   3. canonical fingerprints (VIEW + SYMMETRY, ops/symmetry.py)
   4. dedup: probe the tiered seen-set runs (searchsorted each),
      first-occurrence within the chunk
-  5. scatter survivors into the device next-frontier buffer and their
-     (parent gid, candidate) rows into the device journal
+  5. compact survivors to a dense prefix block and APPEND it at the
+     running cursor of the device next-frontier buffer — and their
+     (parent gid, candidate) rows at the journal cursor — with one
+     dynamic_update_slice each (contiguous writes; the round-6 emit
+     redesign retired the full-capacity scatters this step used to do)
   6. evaluate invariants on the compacted candidates, folding the first
      violating gid per invariant into a device accumulator
   7. emit the chunk's new fingerprints as one small sorted run
@@ -53,7 +56,10 @@ from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
 from .bfs import CheckResult, Violation
 from .lsm import CanonMemo, pow2_at_least
-from .util import GROWTH, HEADROOM, I32_MAX, next_cap, probe_sorted as _probe
+from .util import (
+    GROWTH, HEADROOM, I32_MAX, dense_prefix_sel, emit_append,
+    jit_with_donation, next_cap, probe_sorted as _probe,
+)
 
 
 class DeviceBFS:
@@ -197,19 +203,51 @@ class DeviceBFS:
         self._seen_real = n
 
     def _merge_seen(self, ladder, new_real: int) -> None:
-        """seen <- sort(concat(seen, *ladder))[:target] on device. The
-        truncation only drops U64_MAX padding: new_real <= target by
-        construction of the size ladder."""
+        """seen <- sort(concat(seen, *ladder)) resized to EXACTLY the
+        ladder size `target` on device. Truncation only drops U64_MAX
+        padding (new_real <= target by construction); when the concat is
+        SHORTER than target the result is padded back up with U64_MAX —
+        appending the sort key's own padding value keeps the run sorted,
+        and _lsm_export / probe_sorted are padding-blind. Without the
+        pad-up, a merge whose target outgrew the concat total left a
+        non-ladder-size seen run, and the NEXT wave retraced + recompiled
+        the whole wave program at a never-precompiled shape: that one
+        mid-run compile was the unexplained 4.3x final-wave cliff at
+        depth 32 in BENCH_r05.json (~117 s of the 152.6 s wave)."""
         target = self._seen_size_for(new_real)
         key = (self._seen.shape[0], tuple(l.shape[0] for l in ladder), target)
         fn = self._merge_cache.get(key)
         if fn is None:
-            fn = jax.jit(
-                lambda s, *lv: sort_u64(jnp.concatenate([s, *lv]))[:target]
-            )
+            fn = self._make_seen_merge(key)
             self._merge_cache[key] = fn
         self._seen = fn(self._seen, *ladder)
         self._seen_real = new_real
+
+    def _make_seen_merge(self, key):
+        """Build (and compile+probe, via jit_with_donation) the merge
+        program for one (seen size, ladder shapes, target) signature.
+        All inputs are donated: the old seen run and the wave ladder are
+        dead after the merge, so on backends that alias donations the
+        multi-million-lane sort reuses their HBM instead of holding
+        old + new + scratch live at once."""
+        size, lshapes, target = key
+        total = size + sum(lshapes)
+
+        def merge(s, *lv):
+            out = sort_u64(jnp.concatenate([s, *lv]))[:target]
+            if total < target:
+                out = jnp.concatenate(
+                    [out, jnp.full((target - total,), U64_MAX, jnp.uint64)]
+                )
+            return out
+
+        return jit_with_donation(
+            merge,
+            tuple(range(1 + len(lshapes))),
+            lambda: tuple(
+                jnp.full((n,), U64_MAX, jnp.uint64) for n in (size, *lshapes)
+            ),
+        )
 
     def _lsm_export(self) -> np.ndarray:
         """All real fingerprints, sorted (host array; checkpoint format)."""
@@ -326,24 +364,39 @@ class DeviceBFS:
             )[:K]
             cov = cov + jnp.stack([enabled_k, fired_k, new_k], axis=1)
 
-        # 5. scatter into next frontier + journal (row FCAP/JCAP = drop lane)
+        # 5. emit: compact survivors to a dense prefix of a [VC, W]
+        # block (scatter confined to a chunk-sized index buffer), then
+        # ONE dynamic_update_slice per buffer appends the block at the
+        # running cursor. The destinations ncount + (cumsum(new) - 1)
+        # are provably contiguous, but XLA cannot prove it, so the old
+        # `.at[bdst].set()` emit lowered to general scatters over the
+        # full (FCAP, W)/(JCAP,) buffers — 71% of the raft3 per-chunk
+        # stage sum (PROFILE.md round 5). Rows [FCAP, FCAP+VC) /
+        # [JCAP, JCAP+VC) are the drop region replacing the scatter's
+        # drop row; overflow semantics are bit-identical (emit_append).
         ncount = stats[0].astype(jnp.int32)
         jcount = stats[1].astype(jnp.int32)
         npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
-        frontier_ovf = ncount + n_new > FCAP
-        bdst = jnp.where(new, jnp.minimum(ncount + npos, FCAP), FCAP)
-        next_buf = next_buf.at[bdst].set(flatc)
-        journal_ovf = jcount + n_new > JCAP
-        jdst = jnp.where(new, jnp.minimum(jcount + npos, JCAP), JCAP)
-        jparent = jparent.at[jdst].set(base_gid + cursor + sel // A)
-        jcand = jcand.at[jdst].set(sel % A)
+        esel = dense_prefix_sel(new, npos, VC)
+        blk = jnp.concatenate(
+            [flatc, jnp.zeros((1, W), jnp.int32)], axis=0
+        )[esel]
+        jp_blk = jnp.concatenate(
+            [base_gid + cursor + sel // A, jnp.zeros((1,), jnp.int32)]
+        )[esel]
+        jc_blk = jnp.concatenate([sel % A, jnp.zeros((1,), jnp.int32)])[esel]
+        next_buf, frontier_ovf = emit_append(next_buf, blk, ncount, n_new, FCAP)
+        jparent, journal_ovf = emit_append(jparent, jp_blk, jcount, n_new, JCAP)
+        jcand, _ = emit_append(jcand, jc_blk, jcount, n_new, JCAP)
         # NOTE: a searchsorted+scatter linear merge looks asymptotically
-        # better than sort-concat for merging sorted sets, but measures
-        # 47x SLOWER on the TPU (370ms vs 7.8ms at 1M lanes): arbitrary-
+        # better than sort-concat for merging sorted sets, but arbitrary-
         # index scatters serialize on this hardware while XLA's bitonic
-        # sort is fast. All LSM merges therefore use sort-concat (as
-        # 2-key u32 sorts — hashing.py), and the per-chunk sort below is
-        # only R0 = 2^ceil(log2(VC)) lanes.
+        # sort is fast (scripts/emit_micro.py reproduces the scatter
+        # penalty on the current backend; EMIT_MICRO.json carries the
+        # measured numbers that used to live in this comment as
+        # folklore). All LSM merges therefore use sort-concat (as 2-key
+        # u32 sorts — hashing.py), and the per-chunk sort below is only
+        # R0 = 2^ceil(log2(VC)) lanes.
         new_run = sort_u64(jnp.where(new, fps, U64_MAX))
         if self.R0 > VC:
             new_run = jnp.concatenate(
@@ -490,15 +543,13 @@ class DeviceBFS:
     def _precompile_programs(self) -> None:
         W = self.W
         K = self._wave_geom()
-        frontier = jnp.zeros((self.FCAP + 1, W), jnp.int32)
-        ladder = tuple(
-            jnp.full((self.R0 << i,), U64_MAX, jnp.uint64) for i in range(K + 1)
-        )
+        lshapes = tuple((self.R0 << i) for i in range(K + 1))
+        frontier = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
         for si, size in enumerate(self._seen_sizes):
             seen = jnp.full((size,), U64_MAX, jnp.uint64)
-            next_buf = jnp.zeros((self.FCAP + 1, W), jnp.int32)
-            jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
-            jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
+            next_buf = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
+            jparent = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
+            jcand = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
             viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
             stats = jnp.zeros((6,), jnp.int64)
             cov = jnp.zeros((self.n_actions, 3), jnp.int64)
@@ -509,18 +560,15 @@ class DeviceBFS:
             )
             # per-wave seen merges this size can need (targets >= size;
             # one wave adds at most pow2(FCAP) real lanes, so targets
-            # further than two ladder steps up are unreachable)
-            lshapes = tuple(l.shape[0] for l in ladder)
+            # further than two ladder steps up are unreachable).
+            # _make_seen_merge compiles AND executes each program once
+            # (its donation probe) on fresh throwaway buffers — the
+            # cached merges above must never be handed shared arrays,
+            # since a successful donation consumes its inputs.
             for target in self._seen_sizes[si:]:
                 key = (size, lshapes, target)
-                if key in self._merge_cache:
-                    continue
-                fn = jax.jit(
-                    lambda s, *lv, _t=target: sort_u64(
-                        jnp.concatenate([s, *lv]))[:_t]
-                )
-                fn(seen, *ladder)
-                self._merge_cache[key] = fn
+                if key not in self._merge_cache:
+                    self._merge_cache[key] = self._make_seen_merge(key)
 
     # ---------------- capacity growth ----------------
 
@@ -537,11 +585,11 @@ class DeviceBFS:
             new = self._next_cap(
                 ncount * self.HEADROOM, self.FCAP, self.MAX_FCAP, self.GROWTH, self.chunk
             )
-            pad = new - self.FCAP
+            pad = new - self.FCAP  # old buffer already carries its VC pad rows
             frontier = jnp.concatenate(
                 [frontier, jnp.zeros((pad, W), jnp.int32)], axis=0
             )
-            next_buf = jnp.zeros((new + 1, W), jnp.int32)
+            next_buf = jnp.zeros((new + self.VC, W), jnp.int32)
             self.FCAP = new
         if jcount + ncount * self.HEADROOM > self.JCAP and self.JCAP < self.MAX_JCAP:
             new = self._next_cap(
@@ -652,14 +700,16 @@ class DeviceBFS:
         # benchmark's 4M-row frontier (round-5 measurement) for buffers
         # that are almost entirely zeros.
         fr_h, jp_h, jc_h = seed_rows
-        frontier = jnp.zeros((self.FCAP + 1, W), jnp.int32)
+        # rows [FCAP, FCAP+VC) / [JCAP, JCAP+VC) are the emit drop
+        # region (checker/util.py emit_append)
+        frontier = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
         if len(fr_h):
             frontier = lax.dynamic_update_slice(
                 frontier, jnp.asarray(np.ascontiguousarray(fr_h)),
                 (jnp.int32(0), jnp.int32(0)))
-        next_buf = jnp.zeros((self.FCAP + 1, W), jnp.int32)
-        jparent = jnp.zeros((self.JCAP + 1,), jnp.int32)
-        jcand = jnp.zeros((self.JCAP + 1,), jnp.int32)
+        next_buf = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
+        jparent = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
+        jcand = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
         if len(jp_h):
             jparent = lax.dynamic_update_slice(
                 jparent, jnp.asarray(np.ascontiguousarray(jp_h)),
@@ -836,6 +886,17 @@ class DeviceBFS:
                     "distinct_per_s": round(distinct / el, 1),
                     "lsm_runs": 1,
                     "lsm_lanes": int(self._seen.shape[0]),
+                    # emit gauges (round 6): rows appended this wave,
+                    # bytes the emit WROTE (one [VC, W] i32 block + two
+                    # VC i32 journal lanes per chunk — vs the retired
+                    # scatter's full-capacity touch), and how full the
+                    # frontier buffer got — the stall watchdog reads
+                    # these to attribute growth/cliff waves
+                    "emit_rows": ncount,
+                    "emit_bytes": (
+                        (prev_fcount + C - 1) // C
+                    ) * self.VC * (4 * W + 8),
+                    "frontier_fill": round(ncount / self.FCAP, 4),
                 }
                 tel.wave(wm)
                 if tel.active:

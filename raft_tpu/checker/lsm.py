@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.hashing import U64_MAX, sort_u64
+from .util import jit_with_donation
 
 
 def pow2_at_least(n: int) -> int:
@@ -111,13 +112,22 @@ class RunLSM:
     # ---------------- internals ----------------
 
     def _empty_of(self, size: int):
-        """Cached read-only all-U64_MAX run (levels share it; probing it
-        is harmless and merge inputs are never aliased with outputs)."""
+        """Cached read-only all-U64_MAX run. Levels share it and probing
+        it is harmless, but it must NEVER reach a merge: merge inputs are
+        donated (round 6), and a donated shared sentinel would be deleted
+        out from under every other level aliasing it. The cascade never
+        does (it only merges occupied runs, which are real buffers);
+        warmup/probes use _fresh throwaways."""
         if size not in self._empty_cache:
             self._empty_cache[size] = self._put(
                 np.full(self._lead + (size,), np.uint64(U64_MAX))
             )
         return self._empty_cache[size]
+
+    def _fresh(self, size: int):
+        """A fresh, never-shared all-sentinel run for donation probes and
+        warmup merges (both CONSUME their inputs when donation sticks)."""
+        return self._put(np.full(self._lead + (size,), np.uint64(U64_MAX)))
 
     def _jit(self, key, builder):
         fn = self._merge_cache.get(key)
@@ -128,17 +138,34 @@ class RunLSM:
 
     def _merge(self, a, b, out: int | None = None):
         """Per-row sort-concat merge along the lane axis (2-key u32 sort:
-        a u64 lax.sort is ~300x slower on this TPU, ops/hashing.py)."""
+        a u64 lax.sort is ~300x slower on this TPU, ops/hashing.py).
+
+        Both inputs are DONATED (round 6): the cascade only merges runs
+        that are dead afterwards (the occupied run is replaced by the
+        merge output or an empty sentinel, the carry is consumed), so on
+        backends that alias donations the sort reuses their HBM instead
+        of holding both inputs plus the output live. jit_with_donation
+        probes once on throwaway runs and falls back to an undonated jit
+        where XLA cannot alias (e.g. truncate-merges on CPU)."""
         key = (a.shape[-1], b.shape[-1], out)
-
-        def build():
+        fn = self._merge_cache.get(key)
+        if fn is None:
+            na, nb = a.shape[-1], b.shape[-1]
             if out is None:
-                return lambda x, y: sort_u64(
-                    jnp.concatenate([x, y], axis=-1), axis=-1)
-            return lambda x, y: sort_u64(
-                jnp.concatenate([x, y], axis=-1), axis=-1)[..., :out]
-
-        return self._jit(key, build)(a, b)
+                def body(x, y):
+                    return sort_u64(jnp.concatenate([x, y], axis=-1), axis=-1)
+            else:
+                def body(x, y):
+                    return sort_u64(
+                        jnp.concatenate([x, y], axis=-1), axis=-1
+                    )[..., :out]
+            fn = jit_with_donation(
+                body, (0, 1),
+                lambda: (self._fresh(na), self._fresh(nb)),
+                **self._jit_kw,
+            )
+            self._merge_cache[key] = fn
+        return fn(a, b)
 
     def _pad_run(self, run, size: int):
         have = run.shape[-1]
@@ -262,14 +289,14 @@ class RunLSM:
         signature a run can need is compiled (and lands in the
         persistent compile cache) BEFORE the timed region. The cascade
         only ever merges equal-size runs (carries double exactly), so
-        this is the complete signature set."""
+        this is the complete signature set. Fresh throwaway runs, never
+        the shared _empty_of sentinels: merges donate their inputs."""
         for i in range(len(self.runs)):
             size = self.lv_size(i)
-            e = self._empty_of(size)
             if size >= self.TOPSZ:
-                self._merge(e, e, out=size)
+                self._merge(self._fresh(size), self._fresh(size), out=size)
                 break
-            self._merge(e, e)
+            self._merge(self._fresh(size), self._fresh(size))
 
     def export_host(self) -> list[np.ndarray]:
         """Occupied runs fetched to host (raw, sentinel-padded)."""

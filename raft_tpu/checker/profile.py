@@ -24,7 +24,14 @@ the profiler rebuilds the chunk inputs from it). Stages mirror
                canonicalizer has no pruned tier path
   probe        membership probe of the seen run (searchsorted)
   run_emit     sorting the chunk's new fingerprints into its R0-lane run
-  scatter      next-frontier + journal scatter
+  emit_append  the production emit (round 6): dense-prefix compaction of
+               the survivors to a [VC, W] block plus ONE donated
+               dynamic_update_slice cursor append per buffer (frontier,
+               jparent, jcand) — checker/util.py emit_append
+  scatter      RETIRED diagnostic row: the pre-round-6 emit (arbitrary-
+               index scatters into the full-capacity frontier/journal
+               buffers), kept so regenerated profiles show old-vs-new
+               emit cost side by side against archived PROFILE artifacts
   invariants   batched invariant kernels
   lsm_merge_2r0  one R0+R0 run merge (sort of 2*R0 lanes), fitting the
                  n log n constant for the AMORTIZED per-chunk merge cost
@@ -34,10 +41,10 @@ Per-wave cost model: chunks_per_wave * (fused chunk + amortized merge).
 of stages normally OVERESTIMATES it — XLA fuses away intermediates).
 The per-chunk stage sum counts PRODUCTION stages once: canon_memo_hit
 and canon_tier3_local are diagnostic re-measures of sub-paths already
-inside the ``canon`` row (the all-hit floor and the tier-3 resolve), so
-they are reported — their visibility is the point — but excluded from
-the sum and from ``canon_share_of_stage_sum``, which would otherwise
-triple-count canon work.
+inside the ``canon`` row (the all-hit floor and the tier-3 resolve), and
+``scatter`` is the retired emit no production chunk executes — all three
+are reported (their visibility is the point) but excluded from the sum
+and from ``canon_share_of_stage_sum``.
 """
 
 from __future__ import annotations
@@ -53,7 +60,7 @@ import numpy as np
 
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64
 from .device_bfs import DeviceBFS
-from .util import probe_sorted as _probe
+from .util import dense_prefix_sel, emit_append, probe_sorted as _probe
 
 # every stage key profile_stages() promises to report (the tier-1 smoke
 # test asserts each one is present so stage accounting can't silently
@@ -67,11 +74,30 @@ DECLARED_STAGES = (
     "canon_tier3_local",
     "probe",
     "run_emit",
+    "emit_append",
     "scatter",
     "invariants",
     "lsm_merge_2r0",
     "fused_chunk",
 )
+
+
+def _time_donated(fn, make_args, reps: int = 5) -> float:
+    """Median wall seconds of fn(*make_args()) where fn donates some of
+    its arguments: the args are rebuilt OUTSIDE the timed window each
+    rep (donation invalidates them), so the row measures the in-place
+    program alone, not the rebuild."""
+    out = fn(*make_args())
+    jax.block_until_ready(out)  # warm / compile
+    ts = []
+    for _ in range(reps):
+        args = make_args()
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
 
 
 def _time(fn, *args, reps: int = 5, inner: int = 1) -> float:
@@ -246,7 +272,39 @@ def profile_stages(
 
     st["run_emit"] = _time(jax.jit(run_emit), fps, reps=reps)
 
-    # ---- stage 5b: scatter into frontier + journal ----
+    # ---- stage 5b: the production emit — dense-prefix compaction +
+    # one donated cursor append per buffer (mirrors _chunk_step step 5;
+    # the donated carries are rebuilt outside the timer) ----
+    def emit_stage(flatc, fps, nb, jp, jc):
+        new = ne_u64(fps, U64_MAX)
+        n_new = jnp.sum(new)
+        npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
+        esel = dense_prefix_sel(new, npos, VC)
+        blk = jnp.concatenate(
+            [flatc, jnp.zeros((1, W), jnp.int32)], axis=0
+        )[esel]
+        lanes = jnp.concatenate([npos, jnp.zeros((1,), jnp.int32)])[esel]
+        nb, _ = emit_append(nb, blk, jnp.int32(0), n_new, FCAP)
+        jp, _ = emit_append(jp, lanes, jnp.int32(0), n_new, JCAP)
+        jc, _ = emit_append(jc, lanes, jnp.int32(0), n_new, JCAP)
+        return nb, jp, jc
+
+    emit_j = jax.jit(emit_stage, donate_argnums=(2, 3, 4))
+    st["emit_append"] = _time_donated(
+        emit_j,
+        lambda: (
+            flatc, fps,
+            jnp.zeros((FCAP + VC, W), jnp.int32),
+            jnp.zeros((JCAP + VC,), jnp.int32),
+            jnp.zeros((JCAP + VC,), jnp.int32),
+        ),
+        reps=reps,
+    )
+
+    # ---- stage 5c (RETIRED, diagnostic): the pre-round-6 emit — full-
+    # capacity arbitrary-index scatters. Self-contained (allocates its
+    # own buffers in-program) so the row stays comparable with archived
+    # PROFILE artifacts; excluded from the stage sum. ----
     def scatter(flatc, fps):
         new = ne_u64(fps, U64_MAX)
         npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
@@ -285,7 +343,7 @@ def profile_stages(
     frontier_d = jnp.asarray(
         np.concatenate([
             frontier_h,
-            np.zeros((FCAP + 1 - fcount, W), np.int32),
+            np.zeros((FCAP + VC - fcount, W), np.int32),
         ])
     )
 
@@ -294,9 +352,9 @@ def profile_stages(
         # rebuilt per call — donation invalidates their buffers. The
         # memo is a COPY of the warm table so the fused row reflects the
         # production mixed hit/miss path.
-        nb = jnp.zeros((FCAP + 1, W), jnp.int32)
-        jp = jnp.zeros((JCAP + 1,), jnp.int32)
-        jc = jnp.zeros((JCAP + 1,), jnp.int32)
+        nb = jnp.zeros((FCAP + VC, W), jnp.int32)
+        jp = jnp.zeros((JCAP + VC,), jnp.int32)
+        jc = jnp.zeros((JCAP + VC,), jnp.int32)
         viol = jnp.full((max(1, len(invariants)),), np.int32(2**31 - 1), jnp.int32)
         stats = jnp.zeros((6,), jnp.int64)
         memo = jnp.array(m_warm) if use_memo else dev._memo.reset()
@@ -315,10 +373,11 @@ def profile_stages(
 
     # PRODUCTION stages only: canon_memo_hit / canon_tier3_local re-time
     # sub-paths already inside the `canon` row (the all-hit floor and the
-    # tier-3 resolve), so adding them would triple-count canon work. A
-    # chunk pays `canon` once — that row is the mixed hit/miss path.
+    # tier-3 resolve), and `scatter` is the retired emit no production
+    # chunk executes — adding them would double-count (or resurrect)
+    # work. A chunk pays `canon` and `emit_append` once each.
     timed = [
-        "expand", "compact", "canon", "probe", "run_emit", "scatter",
+        "expand", "compact", "canon", "probe", "run_emit", "emit_append",
     ]
     if invariants:
         timed.append("invariants")
@@ -352,9 +411,10 @@ def render(prof: dict) -> str:
         f"{'stage':<16}{'ms':>10}{'share':>8}",
     ]
     skip = ("fused_chunk", "lsm_merge_2r0", "null_dispatch")
-    # diagnostic re-measures of canon sub-paths: shown (relative to the
-    # production sum) but not part of it — see per_wave_s accounting
-    diag = ("canon_memo_hit", "canon_tier3_local")
+    # diagnostic rows: canon sub-path re-measures and the RETIRED scatter
+    # emit — shown (relative to the production sum) but not part of it,
+    # see per_wave_s accounting
+    diag = ("canon_memo_hit", "canon_tier3_local", "scatter")
     null = s.get("null_dispatch", 0.0)
     tot = sum(max(0.0, v - null) for k, v in s.items()
               if k not in skip and k not in diag)
@@ -363,8 +423,8 @@ def render(prof: dict) -> str:
         mark = "*" if k in diag else ""
         lines.append(f"{k + mark:<16}{v * 1e3:>10.2f}{share:>8.1%}")
     if any(k in s for k in diag):
-        lines.append("(* diagnostic re-measure of a canon sub-path; "
-                     "not in the stage sum)")
+        lines.append("(* diagnostic row — canon sub-path re-measure or "
+                     "the retired scatter emit; not in the stage sum)")
     pw = prof["per_wave_s"]
     lines.append(
         f"wave: {pw['chunks_per_wave']} chunks x "

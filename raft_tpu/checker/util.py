@@ -1,10 +1,15 @@
-"""Capacity-growth policy + sorted-set probe shared by the device-resident
-checkers (DeviceBFS and the sharded v2 engine), so a policy fix lands once."""
+"""Capacity-growth policy, sorted-set probe and the contiguous
+cursor-append emit shared by the device-resident checkers (DeviceBFS and
+the sharded engine), so a policy fix lands once."""
 
 from __future__ import annotations
 
+import warnings
+
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..ops.hashing import eq_u64
 
@@ -20,6 +25,72 @@ def probe_sorted(sorted_arr, vals):
     pos = jnp.searchsorted(sorted_arr, vals)
     pos = jnp.clip(pos, 0, sorted_arr.shape[0] - 1)
     return eq_u64(sorted_arr[pos], vals)
+
+
+def dense_prefix_sel(new, npos, n_lanes: int):
+    """Gather indices compacting the ``new`` lanes to a dense prefix.
+
+    ``npos = cumsum(new) - 1`` (int32, the destination rank of each new
+    lane). Returns ``sel`` [n_lanes] with sel[j] = lane index of the
+    j-th new lane for j < n_new, and ``n_lanes`` (the caller's pad/drop
+    row) past the prefix. Same one-hot-scatter idiom as the valid-lane
+    compaction in the chunk pipeline: the scatter is confined to an
+    (n_lanes+1)-sized index buffer, never a capacity-sized one.
+    """
+    edst = jnp.where(new, npos, n_lanes)
+    return (
+        jnp.full((n_lanes + 1,), n_lanes, jnp.int32)
+        .at[edst]
+        .set(jnp.arange(n_lanes, dtype=jnp.int32))[:n_lanes]
+    )
+
+
+def emit_append(buf, block, count, n_new, cap: int):
+    """Contiguous cursor-append emit: write ``block`` (B lanes/rows, the
+    first n_new of which are real) into ``buf`` at row ``count`` with ONE
+    ``lax.dynamic_update_slice``. The destinations of a chunk's survivors
+    are provably a dense block at the running cursor, so the append
+    lowers to a copy instead of the full-capacity arbitrary-index
+    scatter ``.at[dst].set()`` lowers to (scripts/emit_micro.py measures
+    the difference; it dominated the stage profile before this path).
+
+    ``buf`` must carry >= B pad rows past ``cap``: rows [cap, cap+B) are
+    the drop region — the append analog of the retired scatter's drop
+    row ``cap``. The start is clamped to ``cap``, so a cursor past
+    capacity (only reachable with the overflow flag already raised, and
+    the run aborting) lands the whole block in the pad region and rows
+    [0, cap) stay bit-identical to the scatter path's.
+
+    Returns ``(buf, overflow)`` with ``overflow = count + n_new > cap``.
+    """
+    start = jnp.minimum(count, cap)
+    if buf.ndim == 2:
+        buf = lax.dynamic_update_slice(buf, block, (start, jnp.int32(0)))
+    else:
+        buf = lax.dynamic_update_slice(buf, block, (start,))
+    return buf, count + n_new > cap
+
+
+def jit_with_donation(fn, donate_argnums, probe_args, **jit_kw):
+    """``jax.jit(fn, donate_argnums=...)`` when the backend can actually
+    alias the donated buffers, a plain ``jax.jit(fn)`` otherwise.
+
+    XLA only reports an unusable donation as a UserWarning at the first
+    EXECUTION (e.g. a sort-concat-truncate merge never aliases on the
+    CPU backend even at matching sizes), so the compiled program is
+    probed once on throwaway buffers — fresh from ``probe_args()``,
+    because a successful donation consumes them. Production calls then
+    never warn and never silently copy a buffer the caller believed was
+    updated in place.
+    """
+    jitted = jax.jit(fn, donate_argnums=donate_argnums, **jit_kw)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = jitted(*probe_args())
+        jax.block_until_ready(out)
+    if any("donated" in str(w.message) for w in caught):
+        return jax.jit(fn, **jit_kw)
+    return jitted
 
 
 def next_cap(needed: int, cap: int, max_cap: int, growth: int, unit: int) -> int:

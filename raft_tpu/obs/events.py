@@ -44,11 +44,18 @@ MANIFEST_KEYS = (
     "invariants", "action_names", "when",
 )
 
+# emit_rows/emit_bytes/frontier_fill (round 6): rows the wave's
+# contiguous cursor-append emit landed, bytes it wrote, and frontier-
+# buffer occupancy (worst shard; 0.0 on the unbounded host engine) — so
+# the stall watchdog can tell an emit-bound or growth/recompile wave
+# from a compute-bound one (the depth-32 cliff of BENCH_r05.json was
+# attributed with exactly these gauges).
 WAVE_KEYS = (
     "event", "wave", "depth", "frontier", "new", "distinct",
     "generated", "generated_total", "terminal", "dedup_hit_rate",
     "canon_memo_hits", "canon_memo_hit_rate", "overflow_bits",
     "lsm_runs", "lsm_lanes", "wave_s", "elapsed_s", "distinct_per_s",
+    "emit_rows", "emit_bytes", "frontier_fill",
 )
 
 STALL_KEYS = (

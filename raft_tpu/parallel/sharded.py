@@ -64,7 +64,8 @@ from ..checker.lsm import CanonMemo, RunLSM, pow2_at_least
 from ..obs import NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..checker.util import (
-    GROWTH, HEADROOM, I32_MAX, next_cap as _next_cap, probe_sorted as _probe,
+    GROWTH, HEADROOM, I32_MAX, dense_prefix_sel, emit_append,
+    next_cap as _next_cap, probe_sorted as _probe,
 )
 from ..ops.hashing import (
     U64_MAX, eq_u64, ne_u64, sort_u64, sort_u64_with_idx, split_u64,
@@ -146,6 +147,9 @@ class ShardedBFS:
         self.VC = min(chunk * self.A, chunk * valid_per_state)
         # a chunk receives at most D*RC routed lanes; RC defaults to VC
         self.RC = route_cap if route_cap is not None else self.VC
+        # emit drop-region rows past FCAP/JCAP: one chunk appends at most
+        # the D*RC received lanes (checker/util.py emit_append)
+        self.EPAD = self.D * self.RC
         frontier_cap = ((frontier_cap + chunk - 1) // chunk) * chunk
         self.FCAP = frontier_cap
         self.JCAP = journal_cap if journal_cap is not None else seen_cap
@@ -241,8 +245,9 @@ class ShardedBFS:
     ):
         """One chunk of the current wave on one chip.
 
-        frontier [1,F+1,W]; fcount/base_lgid [1,1]; next_buf [1,F+1,W];
-        jps/jpl/jcand [1,JC+1]; viol [1,K]; occ bool[L] (replicated);
+        frontier [1,F+EPAD,W]; fcount/base_lgid [1,1]; next_buf
+        [1,F+EPAD,W]; jps/jpl/jcand [1,JC+EPAD] (the EPAD=D*RC tail rows
+        are the emit drop region); viol [1,K]; occ bool[L] (replicated);
         runs: L sharded [1,lanes] sorted u64; memo [1,MCAP,2] shard-local
         canon memo; cov [1,n_actions,3] i64 per-shard cumulative
         [enabled, fired, new] per action rank (enabled/fired tally on the
@@ -371,19 +376,33 @@ class ShardedBFS:
         new = fresh
         n_new = jnp.sum(new)
 
-        # 7. scatter survivors into next frontier + journal
+        # 7. emit survivors: compact to a dense prefix of a [D*RC, W]
+        # block, then ONE dynamic_update_slice per buffer appends at the
+        # running cursor (rows [F, F+D*RC) / [JC, JC+D*RC) are the drop
+        # region — checker/util.py emit_append; same redesign as
+        # DeviceBFS._chunk_step step 5, retiring full-capacity scatters)
         ncount = stats[0].astype(jnp.int32)
         jcount = stats[1].astype(jnp.int32)
         npos = (jnp.cumsum(new) - 1).astype(jnp.int32)
-        frontier_ovf = ncount + n_new > F
-        journal_ovf = jcount + n_new > JC
         states_s = recv_pay[sidx, :W]
-        bdst = jnp.where(new, jnp.minimum(ncount + npos, F), F)
-        next_buf = next_buf.at[bdst].set(states_s)
-        jdst = jnp.where(new, jnp.minimum(jcount + npos, JC), JC)
-        jps = jps.at[jdst].set((sidx // RC).astype(jnp.int32))
-        jpl = jpl.at[jdst].set(recv_pay[sidx, W])
-        jcand = jcand.at[jdst].set(recv_pay[sidx, W + 1])
+        B = D * RC
+        esel = dense_prefix_sel(new, npos, B)
+        blk = jnp.concatenate(
+            [states_s, jnp.zeros((1, W), jnp.int32)], axis=0
+        )[esel]
+        jps_blk = jnp.concatenate(
+            [(sidx // RC).astype(jnp.int32), jnp.zeros((1,), jnp.int32)]
+        )[esel]
+        jpl_blk = jnp.concatenate(
+            [recv_pay[sidx, W], jnp.zeros((1,), jnp.int32)]
+        )[esel]
+        jc_blk = jnp.concatenate(
+            [recv_pay[sidx, W + 1], jnp.zeros((1,), jnp.int32)]
+        )[esel]
+        next_buf, frontier_ovf = emit_append(next_buf, blk, ncount, n_new, F)
+        jps, journal_ovf = emit_append(jps, jps_blk, jcount, n_new, JC)
+        jpl, _ = emit_append(jpl, jpl_blk, jcount, n_new, JC)
+        jcand, _ = emit_append(jcand, jc_blk, jcount, n_new, JC)
         if K:
             # new-distinct per rank on the owner chip (non-new lanes ->
             # drop bucket K; their routed rank column may be garbage 0s
@@ -453,15 +472,15 @@ class ShardedBFS:
         if ncount * self.HEADROOM > self.FCAP and self.FCAP < self.MAX_FCAP:
             new = _next_cap(ncount * self.HEADROOM, self.FCAP, self.MAX_FCAP,
                             self.GROWTH, self.chunk)
-            repad("frontier", new + 1, self.FCAP + 1, 0, cols=W)
+            repad("frontier", new + self.EPAD, self.FCAP + self.EPAD, 0, cols=W)
             state["next_buf"] = jax.device_put(
-                np.zeros((D, new + 1, W), np.int32), self._sharding)
+                np.zeros((D, new + self.EPAD, W), np.int32), self._sharding)
             self.FCAP = new
         if jc + ncount * self.HEADROOM > self.JCAP and self.JCAP < self.MAX_JCAP:
             new = _next_cap(jc + ncount * self.HEADROOM, self.JCAP,
                             self.MAX_JCAP, self.GROWTH, 1)
             for key in ("jps", "jpl", "jcand"):
-                repad(key, new + 1, self.JCAP + 1, 0)
+                repad(key, new + self.EPAD, self.JCAP + self.EPAD, 0)
             self.JCAP = new
         return state
 
@@ -575,9 +594,9 @@ class ShardedBFS:
                                   self.FCAP, self.MAX_FCAP, self.GROWTH, self.chunk)
             self.JCAP = _next_cap(max(self.JCAP, jmax + fmax * self.HEADROOM),
                                   self.JCAP, self.MAX_JCAP, self.GROWTH, 1)
-            frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
+            frontier_h = np.zeros((D, self.FCAP + self.EPAD, W), np.int32)
             frontier_h[:, :fmax] = ck["frontier"]
-            jh = {k: np.zeros((D, self.JCAP + 1), np.int32) for k in
+            jh = {k: np.zeros((D, self.JCAP + self.EPAD), np.int32) for k in
                   ("jps", "jpl", "jcand")}
             for k in jh:
                 jh[k][:, :jmax] = ck[k]
@@ -616,7 +635,8 @@ class ShardedBFS:
             state = {
                 "frontier": jax.device_put(frontier_h, self._sharding),
                 "next_buf": jax.device_put(
-                    np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
+                    np.zeros((D, self.FCAP + self.EPAD, W), np.int32),
+                    self._sharding),
                 "jps": jax.device_put(jh["jps"], self._sharding),
                 "jpl": jax.device_put(jh["jpl"], self._sharding),
                 "jcand": jax.device_put(jh["jcand"], self._sharding),
@@ -626,7 +646,7 @@ class ShardedBFS:
                 "stats": jax.device_put(stats_h0, self._sharding),
             }
         else:
-            frontier_h = np.zeros((D, self.FCAP + 1, W), np.int32)
+            frontier_h = np.zeros((D, self.FCAP + self.EPAD, W), np.int32)
             fcounts = np.zeros(D, np.int64)
             self._init_by_shard = [[] for _ in range(D)]
             per_shard_fps: list[list[int]] = [[] for _ in range(D)]
@@ -653,13 +673,17 @@ class ShardedBFS:
             state = {
                 "frontier": jax.device_put(frontier_h, self._sharding),
                 "next_buf": jax.device_put(
-                    np.zeros((D, self.FCAP + 1, W), np.int32), self._sharding),
+                    np.zeros((D, self.FCAP + self.EPAD, W), np.int32),
+                    self._sharding),
                 "jps": jax.device_put(
-                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                    np.zeros((D, self.JCAP + self.EPAD), np.int32),
+                    self._sharding),
                 "jpl": jax.device_put(
-                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                    np.zeros((D, self.JCAP + self.EPAD), np.int32),
+                    self._sharding),
                 "jcand": jax.device_put(
-                    np.zeros((D, self.JCAP + 1), np.int32), self._sharding),
+                    np.zeros((D, self.JCAP + self.EPAD), np.int32),
+                    self._sharding),
                 "viol": jax.device_put(
                     np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
                     self._sharding),
@@ -837,6 +861,16 @@ class ShardedBFS:
                     "shard_new_max": int(new_d.max()),
                     "lsm_runs": sum(self._lsm.occ),
                     "lsm_lanes": int(self._lsm.lanes()),
+                    # emit gauges (round 6): fleet rows appended, bytes
+                    # the append path WROTE (one [D*RC, W] block + three
+                    # journal lanes per chip per chunk), and the worst
+                    # chip's frontier occupancy — frontier_fill nearing
+                    # 1.0 flags an imminent growth/overflow wave for the
+                    # stall watchdog
+                    "emit_rows": global_new,
+                    "emit_bytes": chunks_done * D * (D * self.RC)
+                    * (4 * W + 12),
+                    "frontier_fill": round(int(new_d.max()) / self.FCAP, 4),
                 }
                 tel.wave(wm)
                 if tel.active:

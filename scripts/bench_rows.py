@@ -144,6 +144,24 @@ def row2():
     deep = dev.run(time_budget_s=BUDGET, collect_metrics=True, telemetry=tel)
     last = deep.metrics[-1] if deep.metrics else {}
     out["manifest"] = manifest_fields(tel)
+    # round 6 provenance: (a) the emit is the compact+cursor-append path
+    # (scripts/emit_micro.py measures it against the retired scatter);
+    # (b) the BENCH_r05 4.3x final-wave cliff at depth 32 was NOT emit
+    # cost — the seen truncate-merge's `[:target]` left a non-ladder-size
+    # run when target > concat, forcing a full wave-program retrace at a
+    # never-precompiled shape on the next wave. The merge now pads its
+    # output to exactly `target` with U64_MAX sentinels (invisible to
+    # export/probe), so every wave re-enters a precompiled signature.
+    out["notes"] = {
+        "emit": "compact+cursor-append (round 6); per-wave emit_rows/"
+                "frontier_fill gauges in the metrics stream",
+        "final_wave_cliff": "BENCH_r05 depth-32 4.3x wave-time cliff "
+                            "diagnosed as a seen-merge shape retrace "
+                            "(truncated non-ladder run size), fixed by "
+                            "padding merged seen runs to the ladder "
+                            "target; wave times now stay on precompiled "
+                            "signatures",
+    }
     out["deep"] = {
         "distinct": deep.distinct,
         "depth": deep.depth,

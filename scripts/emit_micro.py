@@ -1,0 +1,161 @@
+"""Microbench: what does ONE chunk's frontier emit cost, by strategy?
+
+Reproduces the "capacity-sized scatter penalty" claim that used to live
+as a folklore number in device_bfs.py: scattering VC survivor rows into
+a full-capacity [FCAP, W] buffer with arbitrary destination indices
+(`.at[dst].set()`) versus the round-6 production path (dense-prefix
+compaction to a [VC, W] block + ONE donated dynamic_update_slice at the
+frontier cursor) versus a sort-based emit (stable argsort of the keep
+mask + gather + the same cursor append).
+
+All three variants write the same rows to the same destinations; all
+donate the big buffer so XLA may update in place; the donated buffer is
+rebuilt OUTSIDE the timed window each rep. The scatter's cost scales
+with FCAP (the whole buffer is touched by the lowering), the appends'
+with VC — sweeping FCAP at fixed VC is the point of the grid.
+
+Usage:
+  python scripts/emit_micro.py [--vc 32768 65536] [--fcap 262144 4194304]
+                               [--w 64] [--reps 5] [--density 0.5]
+                               [--platform cpu]
+
+Writes EMIT_MICRO.json at the repo root (device provenance + one row per
+(VC, FCAP) cell). scripts/profile_workloads.py --md-only folds the
+summary into PROFILE.md.
+
+W defaults to 64 (not a workload's real row width) to keep the 4M-row
+cell around 1 GiB/buffer; pass --w to match a specific workload.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _time_donated(fn, make_args, reps):
+    """Median wall seconds of fn(*make_args()), args rebuilt outside the
+    timed window each rep (donation consumes them)."""
+    import jax
+
+    ts = []
+    for _ in range(reps):
+        args = make_args()
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def bench_cell(vc, fcap, w, reps, density, rng):
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.checker.util import dense_prefix_sel, emit_append
+
+    new_h = rng.random(vc) < density
+    n_new = int(new_h.sum())
+    new = jnp.asarray(new_h)
+    npos = jnp.asarray((new_h.cumsum() - 1).astype("int32"))
+    flatc = jnp.asarray(rng.integers(1, 1 << 20, size=(vc, w), dtype="int64")
+                        .astype("int32"))
+    count = jnp.int32(0)
+
+    # -- retired production emit: arbitrary-index scatter, drop row fcap
+    def scatter_full(nb):
+        dst = jnp.where(new, jnp.minimum(count + npos, fcap), fcap)
+        return nb.at[dst].set(flatc)
+
+    # -- round-6 production emit: compact to a dense [VC, W] block, one
+    #    dynamic_update_slice at the cursor
+    def compact_dus(nb):
+        esel = dense_prefix_sel(new, npos, vc)
+        blk = jnp.concatenate(
+            [flatc, jnp.zeros((1, w), jnp.int32)], axis=0)[esel]
+        nb, _ = emit_append(nb, blk, count, jnp.int32(n_new), fcap)
+        return nb
+
+    # -- alternative: stable sort of the keep mask compacts survivors to
+    #    the front (argsort of ~new), then the same cursor append
+    def sort_emit(nb):
+        order = jnp.argsort(~new, stable=True)
+        blk = flatc[order]
+        nb, _ = emit_append(nb, blk, count, jnp.int32(n_new), fcap)
+        return nb
+
+    variants = {
+        # scatter needs only the drop row past fcap; the appends need a
+        # full VC-row drop region (same geometry the engines carry)
+        "scatter_full": (scatter_full, fcap + 1),
+        "compact_dus": (compact_dus, fcap + vc),
+        "sort_emit": (sort_emit, fcap + vc),
+    }
+    row = {"vc": vc, "fcap": fcap, "n_new": n_new}
+    for name, (fn, rows) in variants.items():
+        jf = jax.jit(fn, donate_argnums=(0,))
+        make = lambda rows=rows: (jnp.zeros((rows, w), jnp.int32),)
+        jax.block_until_ready(jf(*make()))  # compile outside the timer
+        row[f"{name}_ms"] = round(_time_donated(jf, make, reps) * 1e3, 3)
+    row["scatter_over_compact"] = round(
+        row["scatter_full_ms"] / max(row["compact_dus_ms"], 1e-6), 1)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--vc", type=int, nargs="+", default=[32768, 65536])
+    ap.add_argument("--fcap", type=int, nargs="+",
+                    default=[262144, 4194304])
+    ap.add_argument("--w", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--density", type=float, default=0.5)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    rows = []
+    hdr = (f"{'VC':>8} {'FCAP':>9} {'scatter':>10} {'compact':>10} "
+           f"{'sort':>10} {'scatter/compact':>16}")
+    print(hdr)
+    for vc in args.vc:
+        for fcap in args.fcap:
+            row = bench_cell(vc, fcap, args.w, args.reps, args.density, rng)
+            rows.append(row)
+            print(f"{row['vc']:>8} {row['fcap']:>9} "
+                  f"{row['scatter_full_ms']:>8.2f}ms "
+                  f"{row['compact_dus_ms']:>8.2f}ms "
+                  f"{row['sort_emit_ms']:>8.2f}ms "
+                  f"{row['scatter_over_compact']:>15.1f}x", flush=True)
+
+    out = {
+        "meta": {
+            "device": str(jax.devices()[0]),
+            "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "w": args.w, "reps": args.reps, "density": args.density,
+            "note": "ms per emit of one chunk's survivors into a "
+                    "frontier-shaped [rows, W] i32 buffer; all variants "
+                    "donate the buffer and rebuild it outside the timer",
+        },
+        "rows": rows,
+    }
+    path = os.path.join(ROOT, "EMIT_MICRO.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

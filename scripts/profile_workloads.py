@@ -73,6 +73,42 @@ def _model_raft5():
 WL = {"raft3": _model_raft3, "fsync": _model_fsync, "raft5": _model_raft5}
 
 
+def _emit_micro_md():
+    """PROFILE.md section summarizing EMIT_MICRO.json (emit-strategy
+    microbench, `python scripts/emit_micro.py`) when it exists — the
+    reproducible form of the capacity-sized-scatter-penalty claim the
+    emit-append rewrite rests on."""
+    path = os.path.join(ROOT, "EMIT_MICRO.json")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        em = json.load(f)
+    m = em["meta"]
+    md = ["## emit microbench (scripts/emit_micro.py)",
+          "",
+          f"Device: {m['device']} ({m['when']}), W={m['w']}, "
+          f"density={m['density']}, reps={m['reps']}. One chunk's",
+          "survivor emit into a frontier-shaped i32 buffer, by strategy:",
+          "retired full-capacity scatter vs production compact+append",
+          "vs sort-based compaction. All variants donate the buffer.",
+          "Read with the per-workload `scatter` rows above: a DONATED",
+          "scatter a backend can alias updates in place and can bench",
+          "near the append (CPU does); the penalty appears whenever the",
+          "scatter output cannot alias its operand and the lowering",
+          "materializes the full capacity-sized buffer — the profile's",
+          "self-contained `scatter` row measures exactly that, and it",
+          "is FCAP-bound while the append stays VC-bound.",
+          "",
+          "| VC | FCAP | scatter ms | compact+DUS ms | sort ms | scatter/compact |",
+          "|---:|---:|---:|---:|---:|---:|"]
+    for r in em["rows"]:
+        md.append(f"| {r['vc']} | {r['fcap']} | {r['scatter_full_ms']} "
+                  f"| {r['compact_dus_ms']} | {r['sort_emit_ms']} "
+                  f"| {r['scatter_over_compact']}x |")
+    md.append("")
+    return md
+
+
 def main():
     argv = sys.argv[1:]
     if "--platform" in argv:
@@ -148,17 +184,24 @@ def main():
           "`canon_tier3_local` (the tier-3 resolve alone) re-measure",
           "sub-paths inside `canon`; they are reported for visibility",
           "and excluded from the sum, which would otherwise",
-          "triple-count canon work. (b) tier 3 has no static",
-          "compaction budget anymore: both the tie-group-local and the",
-          "full-table buckets drain in fixed-size blocks of an",
-          "adaptive-trip while_loop, so there is no budget-dependent",
-          "capture skew to correct for (the retired B//16-vs-B//8",
-          "caveat). (c) on the tunnel-connected TPU backend, long",
-          "processes develop a ~100+ ms per-dispatch floor — subtract",
-          "`null_dispatch` when reading raw ms.",
+          "triple-count canon work. (b) `emit_append` is the",
+          "production emit (round 6: dense-prefix compaction + one",
+          "donated cursor append per buffer); `scatter` is the RETIRED",
+          "pre-round-6 emit (full-capacity arbitrary-index scatters),",
+          "kept as a diagnostic row so regenerated profiles show",
+          "old-vs-new emit cost side by side — it is excluded from the",
+          "stage sum. (c) tier 3 has no static compaction budget",
+          "anymore: both the tie-group-local and the full-table",
+          "buckets drain in fixed-size blocks of an adaptive-trip",
+          "while_loop, so there is no budget-dependent capture skew to",
+          "correct for (the retired B//16-vs-B//8 caveat). (d) on the",
+          "tunnel-connected TPU backend, long processes develop a",
+          "~100+ ms per-dispatch floor — subtract `null_dispatch` when",
+          "reading raw ms.",
           ""]
     for name in done:
         md += [f"## {name}", "", "```", render(results[name]), "```", ""]
+    md += _emit_micro_md()
     with open(os.path.join(ROOT, "PROFILE.md"), "w") as f:
         f.write("\n".join(md))
     print("wrote PROFILE.md / PROFILE.json")

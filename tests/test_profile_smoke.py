@@ -32,6 +32,10 @@ def test_profile_reports_every_declared_stage():
     # not-applicable placeholder) on a standard symmetric model
     assert prof["stages_s"]["canon"] > 0.0
     assert prof["stages_s"]["canon_memo_hit"] > 0.0
+    # both emit rows must really time: emit_append is the production
+    # path, scatter the retired diagnostic kept for old-vs-new profiles
+    assert prof["stages_s"]["emit_append"] > 0.0
+    assert prof["stages_s"]["scatter"] > 0.0
     # raft3 (S=3) has no pruned tier path, so the tier-3 stage reports
     # its placeholder — present, exactly 0.0
     assert prof["stages_s"]["canon_tier3_local"] == 0.0
