@@ -126,7 +126,15 @@ class BFSChecker:
         self.check_deadlock = check_deadlock
         self.n_actions = len(getattr(model, "ACTION_NAMES", ()))
         self.canon = Canonicalizer.for_model(model, symmetry=symmetry)
-        self._expand = model.expand
+        self._expand = model.expand  # dense path (trace reconstruction)
+        # guard-first sparse expansion (SparseExpandMixin models): the
+        # wave loop runs the cheap guard pass over the dense [chunk, A]
+        # grid and constructs successor rows only for the enabled lanes
+        # (model.host_apply); legacy/custom models keep the dense path
+        self._sparse = hasattr(model, "host_apply")
+        self._guards = (
+            jax.jit(jax.vmap(model.guards1)) if self._sparse else None
+        )
         self._fps = self.canon.fingerprints
         # journal: per distinct state (beyond init): parent global id + candidate
         self._parents: list[np.ndarray] = []
@@ -199,6 +207,7 @@ class BFSChecker:
             # wave-sized arrays
             wave_fps = np.empty(0, dtype=np.uint64)
             n_cand_total = 0
+            wave_extra = 0  # host apply blocks past one per chunk
             has_succ = np.zeros(len(frontier), dtype=bool)
             with tel.wave_annotation(depth + 1):
                 for off in range(0, len(frontier), B):
@@ -207,13 +216,22 @@ class BFSChecker:
                     if nb < B:  # pad to the compiled batch shape
                         pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
                         chunk_states = np.concatenate([chunk_states, pad], axis=0)
-                    succs, valid, rank, ovf = self._expand(chunk_states)
-                    # one fetch for the three per-lane outputs (rank now
-                    # feeds the coverage accumulator)
-                    valid, rank, ovf = (
-                        np.array(x)
-                        for x in jax.device_get((valid, rank, ovf))
-                    )
+                    if self._sparse:
+                        # guard pass only: no [B*A, W] successor rows
+                        valid, rank, ovf = (
+                            np.array(x)
+                            for x in jax.device_get(
+                                self._guards(chunk_states)
+                            )
+                        )
+                    else:
+                        succs, valid, rank, ovf = self._expand(chunk_states)
+                        # one fetch for the three per-lane outputs (rank
+                        # now feeds the coverage accumulator)
+                        valid, rank, ovf = (
+                            np.array(x)
+                            for x in jax.device_get((valid, rank, ovf))
+                        )
                     valid[nb:] = False
                     if np.any(valid & ovf):
                         raise OverflowError(
@@ -228,9 +246,29 @@ class BFSChecker:
                         hit = np.zeros((len(valid), K + 1), dtype=bool)
                         hit[np.arange(len(valid))[:, None], rk] = True
                         cov[:, 0] += hit[:, :K].sum(axis=0)
-                    flat = succs.reshape(-1, model.layout.W)
-                    fps = np.array(jax.device_get(self._fps(flat)), dtype=np.uint64)
-                    fps[~valid.reshape(-1)] = U64_MAX
+                    if self._sparse:
+                        # apply pass: construct rows for the enabled
+                        # lanes only, then fan their fingerprints back
+                        # out to flat-lane indexing so dedup, journal
+                        # and coverage below are shared verbatim with
+                        # the dense path (bit-identical)
+                        en_idx = np.nonzero(valid.reshape(-1))[0]
+                        rows, extra = model.host_apply(
+                            np.asarray(chunk_states), en_idx
+                        )
+                        wave_extra += extra
+                        fps = np.full(
+                            B * model.A, U64_MAX, dtype=np.uint64
+                        )
+                        if len(en_idx):
+                            fps[en_idx] = self._fps_rows(rows)
+                    else:
+                        flat = succs.reshape(-1, model.layout.W)
+                        fps = np.array(
+                            jax.device_get(self._fps(flat)),
+                            dtype=np.uint64,
+                        )
+                        fps[~valid.reshape(-1)] = U64_MAX
                     n_cand_total += int(valid.sum())
                     has_succ[off : off + nb] = valid[:nb].any(axis=1)
 
@@ -248,7 +286,13 @@ class BFSChecker:
                         cov[:, 2] += np.bincount(
                             flat_rk[idx], minlength=K + 1)[:K]
                     if len(idx):
-                        sel = np.asarray(jax.device_get(flat[idx]))
+                        if self._sparse:
+                            # idx lanes are all enabled (U64_MAX-masked
+                            # lanes never survive new_mask), so each has
+                            # a row in the compact apply output
+                            sel = rows[np.searchsorted(en_idx, idx)]
+                        else:
+                            sel = np.asarray(jax.device_get(flat[idx]))
                         wave_sb.append(sel)
                         wave_pb.append(base_gid + off + idx // model.A)
                         wave_cb.append((idx % model.A).astype(np.int32))
@@ -300,6 +344,16 @@ class BFSChecker:
                     "emit_rows": len(wave_states),
                     "emit_bytes": emit_bytes,
                     "frontier_fill": 0.0,
+                    # sparse-expand gauges: enabled fraction of the
+                    # dense candidate grid this wave, and how many
+                    # extra fixed-size apply blocks the host path ran
+                    # beyond one per chunk (the host analog of the
+                    # device engines' budget-overflow bit — it loops
+                    # instead of aborting)
+                    "enabled_density": round(
+                        n_cand_total / max(1, prev_frontier * model.A), 4
+                    ),
+                    "expand_budget_ovf": wave_extra,
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
@@ -360,6 +414,23 @@ class BFSChecker:
             metrics=metrics,
             coverage=[[int(x) for x in row] for row in cov] if K else None,
         )
+
+    def _fps_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Canonical fingerprints of a compact [n, W] row block, padded
+        to the next power of two so the jitted canon sees a log-bounded
+        signature set instead of one per distinct worklist length."""
+        n = len(rows)
+        m = 1
+        while m < n:
+            m <<= 1
+        if m > n:
+            rows = np.concatenate(
+                [rows, np.repeat(rows[-1:], m - n, axis=0)]
+            )
+        fps = np.asarray(
+            jax.device_get(self._fps(rows)), dtype=np.uint64
+        )
+        return fps[:n]
 
     def _coverage_fields(self, depth, cov, seen_len, depth_counts) -> dict:
         """Coverage-event payload (events.COVERAGE_KEYS). The host engine

@@ -76,6 +76,14 @@ class DeviceBFS:
       valid_per_state  compaction budget: avg valid successors per state
                        (Raft-family specs average ~5 of A~53; 16 is
                        generous, overflow-checked)
+      valid_per_group  apply budget for guard-first sparse expansion:
+                       per-group cap on enabled candidates per state
+                       (chunk-aggregate; dict maps group name -> cap,
+                       fractions legal). None = loose bound, which is
+                       overflow-impossible but pays for every slot of
+                       wide groups; tune from the coverage table /
+                       enabled_density gauge. Ignored for models
+                       without the sparse expand contract.
 
     Checkpoint/resume (SURVEY.md §5.4; TLC has it built in): pass
     checkpoint_path (+ checkpoint_every_s) to run(), and resume= to pick
@@ -95,6 +103,7 @@ class DeviceBFS:
         seen_cap: int = 1 << 22,
         journal_cap: int = 1 << 22,
         valid_per_state: int = 16,
+        valid_per_group: float | dict | None = None,
         check_deadlock: bool = False,
         max_frontier_cap: int = 1 << 22,
         max_seen_cap: int = 1 << 25,
@@ -118,6 +127,20 @@ class DeviceBFS:
         self.MAX_SCAP = max(max_seen_cap, seen_cap)
         self.MAX_JCAP = max(max_journal_cap, journal_cap)
         self.VC = min(chunk * self.A, chunk * valid_per_state)
+        # guard-first sparse expansion (SparseExpandMixin models): cheap
+        # guards over the dense [chunk, A] grid, then a vmapped apply
+        # over a static per-group budget plan instead of materializing
+        # all chunk*A successor rows. valid_per_group tunes the plan
+        # (per-state units, chunk-aggregate; dict maps group name ->
+        # cap); None keeps the loose overflow-impossible bound. Legacy
+        # / custom models without the mixin keep the dense path.
+        self._sparse = hasattr(model, "sparse_apply")
+        self.valid_per_group = valid_per_group
+        self._plan = (
+            model.sparse_plan(chunk, self.VC, valid_per_group)
+            if self._sparse
+            else None
+        )
         assert chunk <= frontier_cap
         # the per-chunk dynamic_slice would clamp an out-of-bounds start and
         # silently re-expand earlier rows (while `live` still used the
@@ -284,7 +307,13 @@ class DeviceBFS:
         )
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
         live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
-        succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
+        if self._sparse:
+            # guard pass only: valid/rank/ovf over the dense [C, A]
+            # grid without materializing any W-wide successor rows
+            # (DCE-derived from _expand1, bit-identical by construction)
+            valid, rank, ovf = jax.vmap(model.guards1)(batch)
+        else:
+            succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
         valid = valid & live[:, None]
         expand_ovf = jnp.any(valid & ovf)
         n_gen = jnp.sum(valid)
@@ -301,10 +330,20 @@ class DeviceBFS:
             .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
         )
         selv = sel < C * A
-        flatp = jnp.concatenate(
-            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
-        )
-        flatc = flatp[sel]  # [VC, W]
+        if self._sparse:
+            # apply pass: construct successors ONLY for the compacted
+            # worklist lanes, vmapped per group over the static budget
+            # plan (precompiled signatures). Budget overflow folds into
+            # the compaction bit: both mean "a static worklist bound
+            # was exceeded, raise the knob".
+            flatc, apply_ovf = model.sparse_apply(batch, sel, selv, self._plan)
+            compact_ovf = compact_ovf | apply_ovf
+        else:
+            flatp = jnp.concatenate(
+                [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)],
+                axis=0,
+            )
+            flatc = flatp[sel]  # [VC, W]
 
         # 3. canonical fingerprints on compacted lanes only, through the
         # raw-keyed canon memo (duplicate successors skip the tiered
@@ -806,12 +845,13 @@ class DeviceBFS:
                     self._save_checkpoint(
                         checkpoint_path, frontier, jparent, jcand, fcount,
                         scount, distinct, total, terminal, depth, base_gid,
-                        gen_prev, depth_counts,
+                        gen_prev, depth_counts, cov_h,
                     )
                     saved = f"; wave-start checkpoint saved to {checkpoint_path}"
                 raise OverflowError(
                     f"device BFS capacity overflow (bits={ovf_bits:04b}: "
-                    "1=msg-slots 2=valid_per_state 4=frontier_cap 8=journal_cap)"
+                    "1=msg-slots 2=valid_per_state/valid_per_group "
+                    "4=frontier_cap 8=journal_cap)"
                     + saved
                 )
             # the wave completed: adopt its cumulative coverage (the
@@ -897,6 +937,18 @@ class DeviceBFS:
                         (prev_fcount + C - 1) // C
                     ) * self.VC * (4 * W + 8),
                     "frontier_fill": round(ncount / self.FCAP, 4),
+                    # sparse-expand gauges (derived from stats the wave
+                    # already fetched — zero extra device syncs):
+                    # enabled fraction of the dense [chunk, A] candidate
+                    # grid this wave (the guard-first win scales with
+                    # its inverse), and whether the apply budget plan
+                    # overflowed (always 0 on surviving waves — the
+                    # abort above fires first; host BFS reports real
+                    # extra-batch counts here)
+                    "enabled_density": round(
+                        wave_gen / max(1, prev_fcount * self.A), 4
+                    ),
+                    "expand_budget_ovf": (ovf_bits >> 1) & 1,
                 }
                 tel.wave(wm)
                 if tel.active:

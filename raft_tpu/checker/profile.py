@@ -7,9 +7,24 @@ the profiler rebuilds the chunk inputs from it). Stages mirror
 ``DeviceBFS._chunk_step`` 1:1:
 
   null_dispatch  a no-op jit call: the dispatch/tunnel floor every other
-                 row also pays once (subtract it when reading raw ms)
-  expand       vmap of the per-action successor kernels
-  compact      valid-lane compaction (cumsum + one-hot select)
+                 row also pays once (the rendered table's `net` column
+                 and all shares have it subtracted)
+  guards       the guard pass of guard-first sparse expansion: valid/
+               rank/ovf over the dense [chunk, A] candidate grid with
+               no W-wide successor rows (DCE-derived from _expand1);
+               0.0 for models without the sparse expand contract
+  apply        the budgeted apply pass: per-group vmapped successor
+               construction over the compacted enabled worklist only
+               (models/base.py sparse_apply); 0.0 when not applicable
+  expand       vmap of the full per-action successor kernels over every
+               [chunk, A] lane — the production expand for legacy dense
+               models, a RETIRED diagnostic row (excluded from the
+               stage sum, like `scatter`) when the sparse path is
+               active, kept so regenerated profiles show the dense-vs-
+               sparse cost side by side
+  compact      valid-lane compaction (cumsum + one-hot select; under
+               the sparse path the [VC, W] successor gather lives in
+               `apply`, so this row times the worklist build alone)
   canon        MEMOIZED canonical fingerprints against the warm run's
                live memo table — the realistic mixed hit/miss path a
                production chunk pays (probe + tiered canon of the
@@ -67,6 +82,8 @@ from .util import dense_prefix_sel, emit_append, probe_sorted as _probe
 # rot when the chunk pipeline changes)
 DECLARED_STAGES = (
     "null_dispatch",
+    "guards",
+    "apply",
     "expand",
     "compact",
     "canon",
@@ -193,13 +210,26 @@ def profile_stages(
     null_j = jax.jit(lambda x: x + 1)
     st["null_dispatch"] = _time(null_j, jnp.zeros((8,), jnp.int32), reps=reps)
 
-    # ---- stage 1: expand ----
+    sparse = getattr(dev, "_sparse", False)
+
+    # ---- stage 1: guard pass (sparse path only) ----
+    if sparse:
+        guards_j = jax.jit(lambda b: jax.vmap(model.guards1)(b))
+        st["guards"] = _time(guards_j, batch, reps=reps)
+    else:
+        st["guards"] = 0.0
+    st["apply"] = 0.0  # placeholder keeps table order; measured below
+
+    # ---- stage 1b: dense expand (production for legacy models; a
+    # retired diagnostic when the sparse path is active) ----
     expand = jax.jit(lambda b: jax.vmap(model._expand1)(b))
     st["expand"] = _time(expand, batch, reps=reps)
     succs, valid, _rank, _ovf = expand(batch)
 
-    # ---- stage 2: compact ----
-    def compact(succs, valid):
+    # ---- stage 2: compact. Under the sparse path the [VC, W]
+    # successor gather moved into `apply`, so this times the worklist
+    # build alone; the dense variant keeps the gather. ----
+    def compact_sel(valid):
         vflat = valid.reshape(-1)
         vpos = jnp.cumsum(vflat) - 1
         sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
@@ -208,15 +238,33 @@ def profile_stages(
             .at[sdst]
             .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
         )
-        selv = sel < C * A
+        return sel, sel < C * A
+
+    def compact(succs, valid):
+        sel, selv = compact_sel(valid)
         flatp = jnp.concatenate(
             [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
         )
         return flatp[sel], selv
 
     compact_j = jax.jit(compact)
-    st["compact"] = _time(compact_j, succs, valid, reps=reps)
+    sel_j = jax.jit(compact_sel)
+    if sparse:
+        st["compact"] = _time(sel_j, valid, reps=reps)
+    else:
+        st["compact"] = _time(compact_j, succs, valid, reps=reps)
     flatc, selv = compact_j(succs, valid)
+
+    # ---- stage 2b: budgeted apply over the compacted worklist (the
+    # production successor construction when sparse; its output is
+    # bit-identical to the dense gather, so downstream stages reuse
+    # flatc either way) ----
+    if sparse:
+        sel, _ = sel_j(valid)
+        apply_j = jax.jit(
+            lambda b, s, sv: model.sparse_apply(b, s, sv, dev._plan)
+        )
+        st["apply"] = _time(apply_j, batch, sel, selv, reps=reps)
 
     # ---- stage 3: canonical fingerprints ----
     if use_memo:
@@ -375,10 +423,23 @@ def profile_stages(
     # sub-paths already inside the `canon` row (the all-hit floor and the
     # tier-3 resolve), and `scatter` is the retired emit no production
     # chunk executes — adding them would double-count (or resurrect)
-    # work. A chunk pays `canon` and `emit_append` once each.
-    timed = [
-        "expand", "compact", "canon", "probe", "run_emit", "emit_append",
-    ]
+    # work. A chunk pays `canon` and `emit_append` once each. Under the
+    # sparse path the production expansion is guards + apply and the
+    # dense `expand` row joins the diagnostic set.
+    if sparse:
+        timed = ["guards", "apply", "compact", "canon", "probe",
+                 "run_emit", "emit_append"]
+        out["diag_rows"] = [
+            "canon_memo_hit", "canon_tier3_local", "scatter", "expand",
+        ]
+    else:
+        timed = [
+            "expand", "compact", "canon", "probe", "run_emit",
+            "emit_append",
+        ]
+        out["diag_rows"] = [
+            "canon_memo_hit", "canon_tier3_local", "scatter",
+        ]
     if invariants:
         timed.append("invariants")
     # each TIMED stage row pays one dispatch floor (floored at 0 so a
@@ -387,11 +448,19 @@ def profile_stages(
     n_chunks = max(1, (fcount + C - 1) // C)
     per_chunk = st["fused_chunk"] + amortized
     canon_sum = max(0.0, st["canon"] - null)
+    # successor-expansion share: guards + apply under the sparse path,
+    # the dense expand row otherwise (the guard-first acceptance gauge)
+    exp_sum = sum(
+        max(0.0, st[k] - null)
+        for k in (("guards", "apply") if sparse else ("expand",))
+    )
     out["per_wave_s"] = {
         "chunks_per_wave": n_chunks,
         "stage_sum_per_chunk": round(chunk_sum, 6),
         "canon_share_of_stage_sum": round(
             canon_sum / chunk_sum, 4) if chunk_sum else 0.0,
+        "expand_share_of_stage_sum": round(
+            exp_sum / chunk_sum, 4) if chunk_sum else 0.0,
         "fused_per_chunk": round(st["fused_chunk"], 6),
         "lsm_merge_amortized_per_chunk": round(amortized, 6),
         "wave_estimate": round(n_chunks * per_chunk, 6),
@@ -408,23 +477,37 @@ def render(prof: dict) -> str:
         f"geometry: chunk={g['chunk']} VC={g['VC']} R0={g.get('R0')} "
         f"FCAP={g['FCAP']} lsm_levels={g.get('lsm_levels')} "
         f"perms={g['perms']}",
-        f"{'stage':<16}{'ms':>10}{'share':>8}",
+        f"{'stage':<18}{'ms':>10}{'net ms':>10}{'share':>8}",
     ]
     skip = ("fused_chunk", "lsm_merge_2r0", "null_dispatch")
-    # diagnostic rows: canon sub-path re-measures and the RETIRED scatter
-    # emit — shown (relative to the production sum) but not part of it,
-    # see per_wave_s accounting
-    diag = ("canon_memo_hit", "canon_tier3_local", "scatter")
+    # diagnostic rows: canon sub-path re-measures, the RETIRED scatter
+    # emit, and (sparse-path profiles) the retired dense expand — shown
+    # (relative to the production sum) but not part of it, see
+    # per_wave_s accounting. Archived PROFILE.json files predate the
+    # diag_rows field; the historical tuple is their fallback.
+    diag = tuple(prof.get(
+        "diag_rows", ("canon_memo_hit", "canon_tier3_local", "scatter")
+    ))
     null = s.get("null_dispatch", 0.0)
     tot = sum(max(0.0, v - null) for k, v in s.items()
               if k not in skip and k not in diag)
     for k, v in s.items():
-        share = max(0.0, v - null) / tot if k not in skip and tot else 0
+        if v == 0.0 and k in ("guards", "apply"):
+            continue  # not-applicable rows (dense-only models)
+        net = max(0.0, v - null)
+        share = net / tot if k not in skip and tot else 0
         mark = "*" if k in diag else ""
-        lines.append(f"{k + mark:<16}{v * 1e3:>10.2f}{share:>8.1%}")
+        lines.append(
+            f"{k + mark:<18}{v * 1e3:>10.2f}{net * 1e3:>10.2f}"
+            f"{share:>8.1%}"
+        )
     if any(k in s for k in diag):
         lines.append("(* diagnostic row — canon sub-path re-measure or "
-                     "the retired scatter emit; not in the stage sum)")
+                     "a retired path; not in the stage sum)")
+    lines.append(
+        "(net ms = ms - null_dispatch: the dispatch/tunnel floor every "
+        "row pays once; shares are over net production rows)"
+    )
     pw = prof["per_wave_s"]
     lines.append(
         f"wave: {pw['chunks_per_wave']} chunks x "

@@ -14,6 +14,7 @@ fields last, so the VIEW projection (``Raft.tla:115`` excludes
 from __future__ import annotations
 
 import math
+import re
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -121,6 +122,324 @@ class ActionLabelMixin:
         if name == "HandleMessage":
             return f"{self.ACTION_NAMES[rank]}(slot {binding[0]})"
         return f"{name}{binding}"
+
+
+@dataclass(frozen=True)
+class SparseGroup:
+    """One contiguous run of same-named bindings in ``self.bindings``:
+    the unit of the guard-first sparse expansion. ``params`` is the
+    static [n, arity] int32 binding table the apply pass gathers its
+    kernel arguments from."""
+
+    name: str
+    off: int  # first candidate index of the group
+    n: int  # candidates in the group
+    params: np.ndarray  # [n, arity] int32
+
+
+class SparseExpandMixin:
+    """Guard-first sparse expansion, shared by every spec lowering.
+
+    ``_expand1`` materializes a full-width successor row for every one
+    of the A candidate bindings — even though coverage shows most are
+    guard-disabled on every wave. This mixin splits that contract in
+    two without touching (or trusting) any kernel code:
+
+      guards1     valid/rank/ovf over all A candidates of one state,
+                  derived from ``_expand1``'s own jaxpr by dead-code-
+                  eliminating the succs output. Bit-identical to the
+                  dense pass by construction (DCE removes equations, it
+                  never rewrites values), and cheap: every W-wide
+                  successor assembly and bag sort-insert is dead once
+                  succs is unused (ops/bag.py computes existed/overflow
+                  BEFORE the sort-insert for exactly this reason).
+      apply1      full (valid, succ, rank, ovf) of ONE (state, cand)
+                  pair: a lax.switch over the binding groups. With a
+                  scalar cand only the selected branch executes.
+      sparse_apply  the engine-facing batched apply: successor rows for
+                  a compacted [VC] worklist of enabled candidates,
+                  built per GROUP in fixed-budget blocks so every wave
+                  stays on one precompiled signature. Per-lane switch
+                  would execute ALL branches under vmap (costing more
+                  than the dense pass it replaces); segmenting the
+                  worklist by group runs each kernel only on its own
+                  lanes.
+
+    Subclass contract: ``self.bindings`` (same-named candidates
+    contiguous, as every lowering already builds them), kernels named
+    ``_snake_case`` of the binding name, overridable per model via
+    ``_kernel_overrides`` for the lowerings whose method names predate
+    the convention.
+    """
+
+    def _kernel_overrides(self) -> dict:
+        """binding name -> bound kernel, for names that do not follow
+        the ``_snake_case`` derivation."""
+        return {}
+
+    def kernel_for(self, name: str):
+        """The per-action kernel ``(s, *binding) -> (valid, succ, rank,
+        ovf)`` registered for binding name ``name``."""
+        ov = self._kernel_overrides()
+        if name in ov:
+            return ov[name]
+        attr = "_" + re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+        kern = getattr(self, attr, None)
+        if kern is None:
+            raise AttributeError(
+                f"{type(self).__name__} has no kernel {attr} for binding "
+                f"{name!r} (declare it in _kernel_overrides)"
+            )
+        return kern
+
+    def sparse_groups(self) -> list[SparseGroup]:
+        """Contiguous same-named runs of ``self.bindings`` with their
+        static parameter tables (cached; bindings are frozen after
+        __init__)."""
+        cached = self.__dict__.get("_sparse_groups")
+        if cached is not None:
+            return cached
+        b = self.bindings
+        groups: list[SparseGroup] = []
+        i = 0
+        while i < len(b):
+            name = b[i][0]
+            j = i
+            while j < len(b) and b[j][0] == name:
+                j += 1
+            params = np.asarray(
+                [list(t[1]) for t in b[i:j]], np.int32
+            ).reshape(j - i, -1)
+            groups.append(SparseGroup(name, i, j - i, params))
+            i = j
+        names = [g.name for g in groups]
+        if len(set(names)) != len(names):
+            raise ValueError(f"non-contiguous binding groups: {names}")
+        self.__dict__["_sparse_groups"] = groups
+        return groups
+
+    # ---------------- guard pass ----------------
+
+    @property
+    def guards1(self):
+        """``(s [W]) -> (valid [A], rank [A], ovf [A])`` — the dense
+        guard grid of one state, with every successor write DCE'd out
+        of ``_expand1``'s jaxpr (lazy-built, cached)."""
+        fn = self.__dict__.get("_guards1_fn")
+        if fn is None:
+            fn = self._build_guards1()
+            self.__dict__["_guards1_fn"] = fn
+        return fn
+
+    def _build_guards1(self):
+        import jax
+        from jax import core
+        from jax.interpreters import partial_eval as pe
+
+        closed = jax.make_jaxpr(self._expand1)(
+            jax.ShapeDtypeStruct((self.layout.W,), jnp.int32)
+        )
+        jaxpr = pe.convert_constvars_jaxpr(closed.jaxpr)
+        n_const = len(closed.consts)
+        # _expand1 returns (succs, valid, rank, ovf): drop succs, keep
+        # the three guard outputs
+        dced, used = pe.dce_jaxpr(jaxpr, [False, True, True, True])
+        kept = [c for c, u in zip(closed.consts, used[:n_const]) if u]
+        state_used = used[n_const]
+
+        def guards1(s):
+            args = [*kept, s] if state_used else list(kept)
+            valid, rank, ovf = core.eval_jaxpr(dced, [], *args)
+            return valid, rank, ovf
+
+        guards1.jaxpr = dced  # the no-W-wide-writes pin inspects this
+        return guards1
+
+    # ---------------- apply pass ----------------
+
+    def apply1(self, s, cand):
+        """Full (valid, succ [W], rank, ovf) of ONE (state, candidate)
+        pair — trace reconstruction / parity checks; ``cand`` must be a
+        scalar so lax.switch executes a single branch."""
+        from jax import lax
+
+        groups = self.sparse_groups()
+        group_of = np.zeros((self.A,), np.int32)
+        for gi, g in enumerate(groups):
+            group_of[g.off : g.off + g.n] = gi
+        cand = jnp.asarray(cand, jnp.int32)
+
+        def branch(g):
+            tbl = jnp.asarray(g.params)
+            kern = self.kernel_for(g.name)
+
+            def run(s, cand):
+                k = jnp.clip(cand - g.off, 0, g.n - 1)
+                args = [tbl[:, c][k] for c in range(tbl.shape[1])]
+                return kern(s, *args)
+
+            return run
+
+        return lax.switch(
+            jnp.asarray(group_of)[cand], [branch(g) for g in groups], s, cand
+        )
+
+    def sparse_plan(
+        self,
+        chunk: int,
+        worklist: int,
+        valid_per_group: float | dict | None = None,
+    ) -> tuple[int, ...]:
+        """Static per-group apply budgets EB_g for a [chunk]-state wave
+        chunk whose enabled worklist is [worklist] lanes long.
+
+        ``valid_per_group`` caps the enabled candidates a group may
+        contribute per chunk, in per-state units (CHUNK-AGGREGATE:
+        EB_g = chunk * cap — a few dense states inside an average
+        chunk don't overflow it). A dict maps group name -> cap for
+        per-group tuning (groups absent from the dict stay loose);
+        fractions are legal (0.25 = one enabled candidate per four
+        states). None keeps the loose ``min(chunk * n_g, worklist)``
+        bound, under which budget overflow is impossible (a group can
+        never hold more enabled worklist lanes than that) but wide
+        groups (the message bag) still pay for every slot. The
+        per-wave ``enabled_density`` gauge and the coverage table's
+        enabled column are the tuning inputs."""
+        plan = []
+        for g in self.sparse_groups():
+            if isinstance(valid_per_group, dict):
+                vpg = valid_per_group.get(g.name)
+            else:
+                vpg = valid_per_group
+            cap = g.n if vpg is None else min(g.n, vpg)
+            plan.append(int(min(math.ceil(chunk * cap), worklist)))
+        return tuple(plan)
+
+    def sparse_apply(self, batch, sel, selv, plan):
+        """Successor rows of a compacted enabled worklist.
+
+        ``batch`` [C, W] chunk states; ``sel`` [VC] flat candidate ids
+        (lane * A + cand) with the drop value C*A past the enabled
+        prefix; ``selv`` = sel < C*A; ``plan`` the static per-group
+        budgets from sparse_plan. Returns (flatc [VC, W], apply_ovf):
+        bit-identical to the dense ``flatp[sel]`` gather for every
+        in-budget worklist lane (drop lanes select a zeros row, exactly
+        as the dense path's appended pad row). Lanes of a group past
+        its budget also land on the zeros row, with ``apply_ovf`` set —
+        the engines fold it into the overflow abort, so no surviving
+        wave ever reads one."""
+        import jax
+
+        C, W = batch.shape
+        A = self.A
+        groups = self.sparse_groups()
+        VC = sel.shape[0]
+        total = sum(plan)
+        group_of = np.zeros((A,), np.int32)
+        for gi, g in enumerate(groups):
+            group_of[g.off : g.off + g.n] = gi
+        wg = jnp.where(
+            selv,
+            jnp.asarray(group_of)[jnp.clip(sel, 0, C * A - 1) % A],
+            len(groups),
+        )
+        selp = jnp.concatenate([sel, jnp.full((1,), C * A, jnp.int32)])
+        row = jnp.full((VC,), total, jnp.int32)  # default: the zeros row
+        apply_ovf = jnp.zeros((), bool)
+        blocks = []
+        base = 0
+        for gi, (g, eb) in enumerate(zip(groups, plan)):
+            mask = wg == gi
+            pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            apply_ovf = apply_ovf | (jnp.sum(mask.astype(jnp.int32)) > eb)
+            # compact the group's worklist lanes to a dense [eb] prefix
+            # (same confined one-hot scatter as the engines' valid-lane
+            # compaction; destination eb is the drop slot)
+            edst = jnp.where(mask, jnp.minimum(pos, eb), eb)
+            idx = (
+                jnp.full((eb + 1,), VC, jnp.int32)
+                .at[edst]
+                .set(jnp.arange(VC, dtype=jnp.int32))[:eb]
+            )
+            flat = selp[idx]  # [eb] flat candidate ids, drop -> C*A
+            lane = jnp.clip(flat // A, 0, C - 1)
+            k = jnp.clip(flat % A - g.off, 0, g.n - 1)
+            srows = batch[lane]
+            tbl = jnp.asarray(g.params)
+            kern = self.kernel_for(g.name)
+            args = [tbl[:, c][k] for c in range(tbl.shape[1])]
+            blocks.append(
+                jax.vmap(lambda s, *a, _k=kern: _k(s, *a)[1])(srows, *args)
+            )
+            row = jnp.where(
+                mask & (pos < eb), base + jnp.minimum(pos, eb - 1), row
+            )
+            base += eb
+        allb = jnp.concatenate(
+            blocks + [jnp.zeros((1, W), jnp.int32)], axis=0
+        )
+        return allb[row], apply_ovf
+
+    # ---------------- host-engine apply ----------------
+
+    def host_apply(self, batch_np, flat_idx, block: int = 1024):
+        """Successor rows for the enabled flat candidates ``flat_idx``
+        (sorted, lane * A + cand) of one host chunk ``batch_np`` [C, W].
+
+        Per-group jitted blocks of a fixed ``block`` size keep every
+        call on a precompiled signature; a group larger than one block
+        LOOPS instead of aborting (the host engine has no fixed device
+        worklist), and the extra batches are reported so the engine can
+        surface them as the ``expand_budget_ovf`` gauge. Returns
+        (rows [len(flat_idx), W] np.int32, extra_batches)."""
+        import jax
+
+        A = self.A
+        groups = self.sparse_groups()
+        out = np.zeros((len(flat_idx), self.layout.W), np.int32)
+        cands = flat_idx % A
+        extra = 0
+        for gi, g in enumerate(groups):
+            m = (cands >= g.off) & (cands < g.off + g.n)
+            if not m.any():
+                continue
+            idxs = flat_idx[m]
+            srows = batch_np[idxs // A]
+            ks = (idxs % A - g.off).astype(np.int32)
+            fn = self._host_group_fn(gi, block)
+            parts = []
+            n = len(idxs)
+            extra += (n - 1) // block
+            for o in range(0, n, block):
+                sb = srows[o : o + block]
+                kb = ks[o : o + block]
+                if len(sb) < block:
+                    pad = block - len(sb)
+                    sb = np.concatenate(
+                        [sb, np.repeat(sb[-1:], pad, axis=0)]
+                    )
+                    kb = np.concatenate([kb, np.repeat(kb[-1:], pad)])
+                parts.append(np.asarray(jax.device_get(fn(sb, kb))))
+            out[m] = np.concatenate(parts, axis=0)[:n]
+        return out, extra
+
+    def _host_group_fn(self, gi: int, block: int):
+        import jax
+
+        cache = self.__dict__.setdefault("_host_group_cache", {})
+        key = (gi, block)
+        if key not in cache:
+            g = self.sparse_groups()[gi]
+            tbl = jnp.asarray(g.params)
+            kern = self.kernel_for(g.name)
+
+            @jax.jit
+            def fn(srows, ks):
+                args = [tbl[:, c][ks] for c in range(tbl.shape[1])]
+                return jax.vmap(lambda s, *a: kern(s, *a)[1])(srows, *args)
+
+            cache[key] = fn
+        return cache[key]
 
 
 def onehot_row(arr, i):

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY
-from .base import ActionLabelMixin
+from .base import ActionLabelMixin, SparseExpandMixin
 
 # enums shared by both variants (identical values in both specs' lowerings)
 FOLLOWER, CANDIDATE, LEADER, NOTMEMBER = range(4)
@@ -74,7 +74,7 @@ PENDING_SNAP_RESPONSE = -2
 R_SENDSNAP, R_HANDLE_SNAPREQ, R_HANDLE_SNAPRESP = 14, 15, 16
 
 
-class ConfigRaftCommon(ActionLabelMixin):
+class ConfigRaftCommon(SparseExpandMixin, ActionLabelMixin):
     """Mixin with the kernels common to both reconfig lowerings.
 
     Subclass contract: ``self.p`` (params with n_servers/max_log/

@@ -528,6 +528,12 @@ class JointRaftModel(ConfigRaftCommon):
 
     # ---------------- full expansion ----------------
 
+    def _kernel_overrides(self) -> dict:
+        return {
+            "AppendOldNewConfigToLog": self._append_old_new,
+            "AppendNewConfigToLog": self._append_new,
+        }
+
     def _config_bindings(self) -> list:
         b = []
         for i in range(self.p.n_servers):

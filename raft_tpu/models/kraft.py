@@ -35,7 +35,12 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import ActionLabelMixin, Layout, messages_are_valid_kernel
+from .base import (
+    ActionLabelMixin,
+    Layout,
+    SparseExpandMixin,
+    messages_are_valid_kernel,
+)
 
 # state[i] enum, shared with oracle/kraft_oracle.py (KRaft.tla:69,87)
 UNATTACHED, VOTED, FOLLOWER, CANDIDATE, LEADER, ILLEGAL = range(6)
@@ -189,7 +194,7 @@ def cached_model(params: "KRaftParams") -> "KRaftModel":
     return _cached_model(params)
 
 
-class KRaftModel(ActionLabelMixin):
+class KRaftModel(SparseExpandMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "KRaft"

@@ -53,7 +53,7 @@ import numpy as np
 from ..ops import bag
 from ..ops.hashing import hash_lanes
 from ..ops.packing import EMPTY, WidePacker, bits_for
-from .base import ActionLabelMixin, Layout
+from .base import ActionLabelMixin, Layout, SparseExpandMixin
 
 # server states (KRaftWithReconfig.tla:354-360). UNATTACHED = 0 doubles as
 # the all-zero unused-slot filler; every kernel gates on `used`.
@@ -280,7 +280,7 @@ def cached_model(params: "KRaftReconfigParams") -> "KRaftReconfigModel":
     return _cached_model(params)
 
 
-class KRaftReconfigModel(ActionLabelMixin):
+class KRaftReconfigModel(SparseExpandMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one constants binding."""
 
     name = "KRaftWithReconfig"

@@ -31,7 +31,12 @@ import numpy as np
 
 from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
-from .base import ActionLabelMixin, Layout, messages_are_valid_kernel
+from .base import (
+    ActionLabelMixin,
+    Layout,
+    SparseExpandMixin,
+    messages_are_valid_kernel,
+)
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 NIL = 0  # leader/votedFor Nil; server i stored as i+1
@@ -160,7 +165,7 @@ def _build_packer(p: PullRaftParams) -> BitPacker:
     )
 
 
-class PullRaftModel(ActionLabelMixin):
+class PullRaftModel(SparseExpandMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants)."""
 
     name = "PullRaft"
@@ -644,6 +649,9 @@ class PullRaftModel(ActionLabelMixin):
         return valid, succ, rank, ovf
 
     # ---------------- full expansion ----------------
+
+    def _kernel_overrides(self) -> dict:
+        return {"SendPullEntriesRequest": self._send_pull}
 
     def _expand1(self, s):
         p = self.p

@@ -32,6 +32,7 @@ from ..ops.packing import EMPTY, BitPacker, bits_for
 from .base import (
     ActionLabelMixin,
     Layout,
+    SparseExpandMixin,
     messages_are_valid_kernel,
     onehot_row,
     onehot_set,
@@ -190,7 +191,7 @@ def cached_model(params: "RaftParams") -> "RaftModel":
     return _cached_model(params)
 
 
-class RaftModel(ActionLabelMixin):
+class RaftModel(SparseExpandMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "Raft"

@@ -502,6 +502,12 @@ class ReconfigRaftModel(ConfigRaftCommon):
 
     # ---------------- full expansion ----------------
 
+    def _kernel_overrides(self) -> dict:
+        return {
+            "AppendAddServerCommandToLog": self._append_add,
+            "AppendRemoveServerCommandToLog": self._append_remove,
+        }
+
     def _config_bindings(self) -> list:
         b = []
         for ij in self._all_pairs:

@@ -50,12 +50,20 @@ MANIFEST_KEYS = (
 # the stall watchdog can tell an emit-bound or growth/recompile wave
 # from a compute-bound one (the depth-32 cliff of BENCH_r05.json was
 # attributed with exactly these gauges).
+# enabled_density/expand_budget_ovf (guard-first sparse expansion):
+# enabled fraction of the dense [chunk, A] candidate grid this wave
+# (the guard-first win scales with its inverse — tune valid_per_group
+# from it), and apply-budget overflow (device engines: the abort bit,
+# 0 on surviving waves; host engine: extra fixed-size apply blocks run
+# beyond one per chunk — it loops instead of aborting). Both derive
+# from counters the wave already fetched: zero extra device syncs.
 WAVE_KEYS = (
     "event", "wave", "depth", "frontier", "new", "distinct",
     "generated", "generated_total", "terminal", "dedup_hit_rate",
     "canon_memo_hits", "canon_memo_hit_rate", "overflow_bits",
     "lsm_runs", "lsm_lanes", "wave_s", "elapsed_s", "distinct_per_s",
     "emit_rows", "emit_bytes", "frontier_fill",
+    "enabled_density", "expand_budget_ovf",
 )
 
 STALL_KEYS = (
@@ -125,6 +133,25 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
         problems.append(
             f"{where}{etype} event missing declared keys: {missing}"
         )
+    if etype == "wave":
+        dens = ev.get("enabled_density")
+        if dens is not None and (
+            isinstance(dens, bool) or not isinstance(dens, (int, float))
+            or not 0.0 <= dens <= 1.0
+        ):
+            problems.append(
+                f"{where}wave enabled_density {dens!r} must be a number "
+                f"in [0, 1] (enabled fraction of the chunk*A grid)"
+            )
+        bovf = ev.get("expand_budget_ovf")
+        if bovf is not None and (
+            isinstance(bovf, bool) or not isinstance(bovf, int)
+            or bovf < 0
+        ):
+            problems.append(
+                f"{where}wave expand_budget_ovf {bovf!r} must be a "
+                f"non-negative int"
+            )
     if etype == "summary" and ev.get("exit_cause") not in EXIT_CAUSES:
         problems.append(
             f"{where}summary exit_cause {ev.get('exit_cause')!r} not in "

@@ -122,6 +122,7 @@ class ShardedBFS:
         devices=None,
         chunk: int = 256,
         valid_per_state: int = 16,
+        valid_per_group: float | dict | None = None,
         route_cap: int | None = None,
         frontier_cap: int = 1 << 12,
         seen_cap: int = 1 << 16,
@@ -145,6 +146,15 @@ class ShardedBFS:
         self.A = model.A
         self.W = model.layout.W
         self.VC = min(chunk * self.A, chunk * valid_per_state)
+        # guard-first sparse expansion (SparseExpandMixin models): see
+        # checker/device_bfs.py — same two-phase contract per shard
+        self._sparse = hasattr(model, "sparse_apply")
+        self.valid_per_group = valid_per_group
+        self._plan = (
+            model.sparse_plan(chunk, self.VC, valid_per_group)
+            if self._sparse
+            else None
+        )
         # a chunk receives at most D*RC routed lanes; RC defaults to VC
         self.RC = route_cap if route_cap is not None else self.VC
         # emit drop-region rows past FCAP/JCAP: one chunk appends at most
@@ -271,7 +281,12 @@ class ShardedBFS:
         # 1. expand `chunk` rows starting at the wave cursor
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
         live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
-        succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
+        if self._sparse:
+            # guard pass: valid/rank/ovf only — no W-wide successor
+            # rows (DCE-derived from _expand1, bit-identical)
+            valid, rank, ovf = jax.vmap(model.guards1)(batch)
+        else:
+            succs, valid, rank, ovf = jax.vmap(model._expand1)(batch)
         valid = valid & live[:, None]
         expand_ovf = jnp.any(valid & ovf)
         n_gen = jnp.sum(valid)
@@ -302,10 +317,18 @@ class ShardedBFS:
             .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
         )
         selv = sel < C * A
-        flatp = jnp.concatenate(
-            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)], axis=0
-        )
-        flatc = flatp[sel]  # [VC, W]
+        if self._sparse:
+            # apply pass over the compacted worklist only; budget
+            # overflow folds into the compaction bit (same remedy:
+            # raise the static budget knob)
+            flatc, apply_ovf = model.sparse_apply(batch, sel, selv, self._plan)
+            compact_ovf = compact_ovf | apply_ovf
+        else:
+            flatp = jnp.concatenate(
+                [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)],
+                axis=0,
+            )
+            flatc = flatp[sel]  # [VC, W]
         parent_lgid = base_lgid + cursor + sel // A
         cand = sel % A
 
@@ -769,8 +792,8 @@ class ShardedBFS:
             if ovf_bits:
                 raise OverflowError(
                     f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
-                    "1=msg-slots 2=valid_per_state 4=route_cap "
-                    "8=frontier_cap 16=journal_cap)")
+                    "1=msg-slots 2=valid_per_state/valid_per_group "
+                    "4=route_cap 8=frontier_cap 16=journal_cap)")
             # commit only after the ovf check: an aborted wave keeps the
             # wave-start counters (consistent with what a checkpoint saved)
             cov_hd = np.asarray(cov_w, dtype=np.int64)
@@ -871,6 +894,13 @@ class ShardedBFS:
                     "emit_bytes": chunks_done * D * (D * self.RC)
                     * (4 * W + 12),
                     "frontier_fill": round(int(new_d.max()) / self.FCAP, 4),
+                    # sparse-expand gauges (checker/device_bfs.py): both
+                    # derive from counters this wave already fetched
+                    "enabled_density": round(
+                        wave_gen / max(1, int(prev_fcounts.sum()) * self.A),
+                        4,
+                    ),
+                    "expand_budget_ovf": (ovf_bits >> 1) & 1,
                 }
                 tel.wave(wm)
                 if tel.active:

@@ -155,6 +155,12 @@ def row2():
     out["notes"] = {
         "emit": "compact+cursor-append (round 6); per-wave emit_rows/"
                 "frontier_fill gauges in the metrics stream",
+        "expand": "guard-first sparse (round 7): DCE guard pass + "
+                  "per-group budgeted apply, loose plan at default "
+                  "knobs; per-wave enabled_density/expand_budget_ovf "
+                  "gauges in the metrics stream; scripts/expand_micro."
+                  "py prices it against both dense baselines "
+                  "(materialized and gather-fused)",
         "final_wave_cliff": "BENCH_r05 depth-32 4.3x wave-time cliff "
                             "diagnosed as a seen-merge shape retrace "
                             "(truncated non-ladder run size), fixed by "

@@ -1,0 +1,295 @@
+"""Microbench: what does ONE chunk's successor expansion cost, by path?
+
+Reproduces the "expand wall" numbers behind the guard-first sparse
+expansion (models/base.py SparseExpandMixin): the dense path runs every
+per-action kernel over all chunk*A candidate lanes and gathers the
+VC-compacted survivors, while the guard-first path runs the DCE-derived
+guard pass (valid/rank/ovf only, no W-wide rows) over the same grid and
+then constructs successors just for the enabled worklist, vmapped per
+action group over a static budget plan. Both paths produce bit-identical
+[VC, W] compacted blocks.
+
+Two dense baselines are timed, because they differ enormously:
+
+  dense_mat  vmap of the full kernels MATERIALIZING the [chunk, A, W]
+             successor tensor (what any consumer that keeps raw succs
+             pays, and what the legacy engines paid while bag_put
+             carried a lax.sort — sorts block producer fusion);
+  dense      the same kernels jitted TOGETHER with the compaction
+             gather. With the branchless shift-insert bag_put (ops/
+             bag.py) every kernel is elementwise, so XLA fuses the
+             producer into the gather and computes kernels only for
+             gathered rows — the compiler discovers the guard-first
+             schedule implicitly. Fusion is a backend heuristic with no
+             contract (it vanished with one lax.sort in the kernel);
+             the explicit guard-first path makes the sparse schedule a
+             guarantee, bounds worst-case work by the audited budget
+             plan (overflow aborts instead of silently densifying), and
+             exports enabled_density / expand_budget_ovf gauges.
+
+``speedup_mat`` is guard-first vs dense_mat (the lane-ratio claim);
+``speedup`` is vs the fused dense baseline — on backends whose fusion
+already sparsifies the gather it hovers near or below 1x, which is the
+honest cost of the explicit worklist bookkeeping. The grid sweeps the
+apply budget (``--vpg``, per-state units; ``loose`` keeps the
+overflow-impossible bound) against chunk size on a REAL reachable
+frontier (guard density is whatever the model exhibits there — the
+``density`` column reports it).
+
+Defaults mirror the raft3 PROFILE workload geometry (3 servers, 2
+values, msg_slots=32 -> A=56); ``--vpg tuned`` is that workload's
+measured per-group budget dict, ``--vpg 8`` a flat per-group cap of 8
+per state, ``loose`` the overflow-impossible bound (all chunk*A lanes,
+grouped — isolates the grouping overhead with zero lane savings).
+
+Usage:
+  python scripts/expand_micro.py [--chunk 1024 4096]
+                                 [--vpg loose 8 tuned]
+                                 [--servers 3] [--values 2]
+                                 [--elections 3] [--restarts 1]
+                                 [--msg-slots 32] [--depth 10]
+                                 [--reps 5] [--platform cpu]
+
+Writes EXPAND_MICRO.json at the repo root (device provenance + one row
+per (chunk, vpg) cell). scripts/profile_workloads.py --md-only folds the
+summary into PROFILE.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _time(fn, *args, reps=5):
+    """Median wall seconds of fn(*args) with block_until_ready."""
+    import jax
+
+    jax.block_until_ready(fn(*args))  # warm / compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def bench_cell(model, batch_h, vpg, reps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    C = len(batch_h)
+    A, W = model.A, model.layout.W
+    VC = min(C * A, C * 16)
+    batch = jnp.asarray(batch_h)
+
+    # -- dense path: full kernels over every lane + compaction gather
+    def dense(b):
+        succs, valid, rank, ovf = jax.vmap(model._expand1)(b)
+        vflat = valid.reshape(-1)
+        vpos = jnp.cumsum(vflat) - 1
+        sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+        sel = (
+            jnp.full((VC + 1,), C * A, jnp.int32)
+            .at[sdst]
+            .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+        )
+        flatp = jnp.concatenate(
+            [succs.reshape(C * A, W), jnp.zeros((1, W), jnp.int32)],
+            axis=0,
+        )
+        return flatp[sel], sel < C * A
+
+    # -- guard-first path, split so each phase gets its own row
+    guards = jax.jit(lambda b: jax.vmap(model.guards1)(b))
+
+    def worklist(valid):
+        vflat = valid.reshape(-1)
+        vpos = jnp.cumsum(vflat) - 1
+        sdst = jnp.where(vflat, jnp.minimum(vpos, VC), VC)
+        sel = (
+            jnp.full((VC + 1,), C * A, jnp.int32)
+            .at[sdst]
+            .set(jnp.arange(C * A, dtype=jnp.int32))[:VC]
+        )
+        return sel, sel < C * A
+
+    plan = model.sparse_plan(C, VC, vpg)
+    apply_j = jax.jit(
+        lambda b, s, sv: model.sparse_apply(b, s, sv, plan)
+    )
+
+    dense_j = jax.jit(dense)
+    # full-kernel vmap that must materialize [C, A, W] — no gather for
+    # the producer to fuse into (valid/rank fold into the same fusion,
+    # so succs-only is the honest materialized cost)
+    dense_mat_j = jax.jit(lambda b: jax.vmap(model._expand1)(b)[0])
+    wl_j = jax.jit(worklist)
+    valid, _, _ = guards(batch)
+    sel, selv = wl_j(valid)
+    flatc_d, _ = dense_j(batch)
+    flatc_s, ovf = apply_j(batch, sel, selv)
+    parity = bool(
+        np.array_equal(np.asarray(flatc_d), np.asarray(flatc_s))
+    )
+    density = float(jnp.sum(valid)) / (C * A)
+
+    row = {
+        "chunk": C, "A": A, "W": W, "vc": VC,
+        "vpg": "loose" if vpg is None else vpg,
+        "plan_lanes": int(sum(plan)),
+        "dense_lanes": C * A,
+        "density": round(density, 4),
+        "budget_ovf": bool(ovf),
+        "parity": parity,
+        "dense_ms": round(_time(dense_j, batch, reps=reps) * 1e3, 3),
+        "dense_mat_ms": round(
+            _time(dense_mat_j, batch, reps=reps) * 1e3, 3),
+        "guards_ms": round(_time(guards, batch, reps=reps) * 1e3, 3),
+        "apply_ms": round(
+            _time(apply_j, batch, sel, selv, reps=reps) * 1e3, 3),
+    }
+    sparse_ms = max(row["guards_ms"] + row["apply_ms"], 1e-6)
+    row["speedup"] = round(row["dense_ms"] / sparse_ms, 2)
+    row["speedup_mat"] = round(row["dense_mat_ms"] / sparse_ms, 2)
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--chunk", type=int, nargs="+", default=[1024, 4096])
+    ap.add_argument("--vpg", nargs="+", default=["loose", "8", "tuned"])
+    ap.add_argument("--servers", type=int, default=3)
+    ap.add_argument("--values", type=int, default=2)
+    ap.add_argument("--elections", type=int, default=3)
+    ap.add_argument("--restarts", type=int, default=1)
+    ap.add_argument("--msg-slots", type=int, default=32)
+    ap.add_argument("--depth", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+
+    from raft_tpu.models.raft import RaftModel, RaftParams
+
+    model = RaftModel(RaftParams(
+        n_servers=args.servers, n_values=args.values,
+        max_elections=args.elections, max_restarts=args.restarts,
+        msg_slots=args.msg_slots,
+    ))
+    # a reachable frontier (manual wave loop with exact-bytes dedup):
+    # guard density on real states is the honest input, random bit
+    # patterns are not; shallow spaces tile the deepest wave
+    frontier = model.init_states()
+    seen = set()
+    for _ in range(args.depth):
+        nxt = []
+        B, W = 1024, model.layout.W
+        for off in range(0, len(frontier), B):
+            cs = frontier[off:off + B]
+            nb = len(cs)
+            if nb < B:
+                cs = np.concatenate(
+                    [cs, np.repeat(cs[-1:], B - nb, axis=0)])
+            succs, valid, _, _ = jax.device_get(model.expand(cs))
+            valid = np.array(valid)
+            valid[nb:] = False
+            flat = np.array(succs).reshape(-1, W)
+            for i in np.nonzero(valid.reshape(-1))[0]:
+                t = flat[i].tobytes()
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(flat[i])
+        if not nxt:
+            break
+        frontier = np.array(nxt, dtype=np.int32)
+        if len(frontier) >= max(args.chunk):
+            break
+    del seen
+
+    rows = []
+    hdr = (f"{'chunk':>6} {'vpg':>6} {'lanes':>8} {'dense':>10} "
+           f"{'densemat':>10} {'guards':>10} {'apply':>10} "
+           f"{'vs_fused':>8} {'vs_mat':>8} {'ovf':>5}")
+    print(hdr)
+    for C in args.chunk:
+        reps_needed = -(-C // len(frontier))
+        batch_h = np.tile(frontier, (reps_needed, 1))[:C]
+        for v in args.vpg:
+            # "tuned" = the raft3 PROFILE workload's measured per-group
+            # budgets (scripts/profile_workloads.py carries the same
+            # dict with the measurement provenance)
+            if v == "loose":
+                vpg = None
+            elif v == "tuned":
+                vpg = {
+                    "Restart": 2.25, "RequestVote": 1.25,
+                    "BecomeLeader": 0.1875, "ClientRequest": 1.0,
+                    "AdvanceCommitIndex": 0.109375,
+                    "AppendEntries": 0.953125, "HandleMessage": 5.75,
+                }
+            else:
+                vpg = float(v)
+            row = bench_cell(model, batch_h, vpg, args.reps)
+            row["vpg"] = v  # the grid label, not the expanded dict
+            rows.append(row)
+            if not row["parity"] and not row["budget_ovf"]:
+                raise AssertionError(
+                    f"sparse/dense parity failed in-budget: {row}")
+            print(f"{row['chunk']:>6} {str(row['vpg']):>6} "
+                  f"{row['plan_lanes']:>8} {row['dense_ms']:>8.2f}ms "
+                  f"{row['dense_mat_ms']:>8.2f}ms "
+                  f"{row['guards_ms']:>8.2f}ms {row['apply_ms']:>8.2f}ms "
+                  f"{row['speedup']:>7.2f}x {row['speedup_mat']:>7.2f}x "
+                  f"{str(row['budget_ovf']):>5}",
+                  flush=True)
+
+    out = {
+        "meta": {
+            "device": str(jax.devices()[0]),
+            "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "model": model.name,
+            "params": {
+                "n_servers": args.servers, "n_values": args.values,
+                "max_elections": args.elections,
+                "max_restarts": args.restarts,
+                "msg_slots": args.msg_slots,
+            },
+            "frontier_depth": args.depth,
+            "reps": args.reps,
+            "note": "ms per chunk of successor expansion on a real "
+                    "reachable frontier; dense_mat = full kernels "
+                    "materializing [chunk, A, W] (no gather to fuse "
+                    "into), dense = same kernels jitted with the "
+                    "compaction gather (with the branchless bag_put the "
+                    "backend fuses the producer into the gather — an "
+                    "implicit, contract-free sparse schedule), "
+                    "guard-first = DCE guard pass + per-group budgeted "
+                    "apply over the enabled worklist (the explicit, "
+                    "budget-audited schedule; bit-identical output, "
+                    "parity checked per cell unless the budget "
+                    "overflowed). speedup is vs dense, speedup_mat vs "
+                    "dense_mat",
+        },
+        "rows": rows,
+    }
+    path = os.path.join(ROOT, "EXPAND_MICRO.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

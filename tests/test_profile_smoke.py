@@ -39,9 +39,18 @@ def test_profile_reports_every_declared_stage():
     # raft3 (S=3) has no pruned tier path, so the tier-3 stage reports
     # its placeholder — present, exactly 0.0
     assert prof["stages_s"]["canon_tier3_local"] == 0.0
+    # RaftModel carries the sparse expand contract: guards and apply
+    # must really time, and the dense expand row must join the
+    # diagnostic set (still measured for old-vs-new comparison, but
+    # excluded from the production stage sum)
+    assert prof["stages_s"]["guards"] > 0.0
+    assert prof["stages_s"]["apply"] > 0.0
+    assert prof["stages_s"]["expand"] > 0.0
+    assert "expand" in prof["diag_rows"]
 
     pw = prof["per_wave_s"]
     assert 0.0 <= pw["canon_share_of_stage_sum"] <= 1.0
+    assert 0.0 <= pw["expand_share_of_stage_sum"] <= 1.0
     assert pw["stage_sum_per_chunk"] > 0.0
 
     txt = render(prof)
