@@ -6,6 +6,19 @@ Mirrors the reference workflow `tlc <Spec>.tla -config <Spec>.cfg -deadlock`
 (reference README.md:5-7): `-deadlock` semantics are the default (terminal
 states are reported, not errors). The CHECKER env var or --checker flag
 selects the backend; `oracle` is the pure-Python differential reference.
+
+Exit codes (stable contract, pinned by tests/test_resilience.py):
+
+    0   clean run, no violations
+    2   invariant or temporal-property violation found
+    3   --coverage=strict dead-action gate tripped
+    4   preempted (SIGTERM/SIGINT): a resumable checkpoint was written
+        at the next wave boundary; re-run with --resume to continue
+    5   unrecoverable failure (retry budget spent, capacity overflow
+        with no growth policy or no checkpoint, all generations corrupt)
+    64  usage/config error (bad flags, bad cfg, checkpoint spec mismatch)
+    66  input file not found (cfg or --resume path)
+    70  fingerprint-collision audit failed
 """
 
 from __future__ import annotations
@@ -51,6 +64,37 @@ def main(argv=None):
                     metavar="S", help="seconds between checkpoints")
     ap.add_argument("--resume", default=None, metavar="PATH",
                     help="resume a run from a --checkpoint file (tpu checker)")
+    ap.add_argument(
+        "--checkpoint-keep",
+        type=int,
+        default=3,
+        metavar="N",
+        help="checkpoint generations to rotate (PATH, PATH.gen1, ...); "
+        "a torn newest generation falls back to the previous intact one",
+    )
+    ap.add_argument(
+        "--supervise",
+        nargs="?",
+        const=5,
+        type=int,
+        default=None,
+        metavar="RETRIES",
+        help="wrap the run in the auto-resume supervisor: capacity "
+        "overflows rebuild the engine with grown capacities and resume "
+        "from the newest intact checkpoint; transient device failures "
+        "retry with exponential backoff (default budget: 5 recoveries)",
+    )
+    ap.add_argument(
+        "--chaos",
+        default=None,
+        metavar="SPEC",
+        help="deterministic fault injection for drills and tests: "
+        "comma-separated key=int pairs from crash=WAVE (raise at wave "
+        "start), transient=WAVE (injected device flake), ovf=WAVE "
+        "(spurious frontier-overflow bit), truncate=NTH (tear the Nth "
+        "checkpoint write), preempt=WAVE (SIGTERM self-delivery), "
+        "seed=S; each fault fires once",
+    )
     ap.add_argument("--max-frontier-cap", type=int, default=None,
                     help="frontier growth bound (tpu checker)")
     ap.add_argument("--max-seen-cap", type=int, default=None,
@@ -171,6 +215,16 @@ def main(argv=None):
     )
     ap.add_argument("--verbose", "-v", action="store_true")
     args = ap.parse_args(argv)
+
+    chaos_spec = None
+    if args.chaos:
+        from .resilience import ChaosSpec
+
+        try:
+            chaos_spec = ChaosSpec.parse(args.chaos)
+        except ValueError as e:
+            print(f"error: --chaos: {e}", file=sys.stderr)
+            return 64
 
     if args.platform != "auto":
         import jax
@@ -400,33 +454,77 @@ def main(argv=None):
                 )
                 return 64
             devs = devs[: args.devices]
-        checker = ShardedBFS(
-            setup.model,
-            invariants=setup.invariants,
-            symmetry=symmetry,
-            devices=devs,
-            chunk=args.chunk,
-            **cli_caps,
-        )
+
+        def make_checker(overrides):
+            return ShardedBFS(
+                setup.model,
+                invariants=setup.invariants,
+                symmetry=symmetry,
+                devices=devs,
+                chunk=args.chunk,
+                **{**cli_caps, **overrides},
+            )
     elif args.checker == "tpu":
         from .checker.device_bfs import DeviceBFS
 
-        checker = DeviceBFS(
-            setup.model,
-            invariants=setup.invariants,
-            symmetry=symmetry,
-            chunk=args.chunk,
-            **cli_caps,
-        )
+        def make_checker(overrides):
+            return DeviceBFS(
+                setup.model,
+                invariants=setup.invariants,
+                symmetry=symmetry,
+                chunk=args.chunk,
+                **{**cli_caps, **overrides},
+            )
     else:
         from .checker.bfs import BFSChecker
 
-        checker = BFSChecker(
-            setup.model,
-            invariants=setup.invariants,
-            symmetry=symmetry,
-            chunk=args.chunk,
+        def make_checker(overrides):
+            # the host engine's buffers are unbounded; overflow growth
+            # policies are the empty dict, so overrides carry no keys
+            return BFSChecker(
+                setup.model,
+                invariants=setup.invariants,
+                symmetry=symmetry,
+                chunk=args.chunk,
+            )
+
+    checker = make_checker({})
+
+    if args.resume is not None:
+        # fail fast, BEFORE the multi-second precompile: prove the
+        # checkpoint exists, loads (falling back through generations)
+        # and matches this exact model/capacity identity
+        from .resilience import ckpt as rckpt
+        from .resilience.errors import CheckpointCorrupt, CheckpointMismatch
+
+        try:
+            gen, ck_depth = rckpt.validate_resume(
+                args.resume, checker._ckpt_ident(), keep=args.checkpoint_keep)
+        except FileNotFoundError as e:
+            print(f"error: --resume: {e}", file=sys.stderr)
+            return 66
+        except CheckpointCorrupt as e:
+            print(f"error: --resume: {e}", file=sys.stderr)
+            for p in e.problems:
+                print(f"  {p}", file=sys.stderr)
+            return 5
+        except CheckpointMismatch as e:
+            print(f"error: --resume: {e}", file=sys.stderr)
+            return 64
+        print(
+            f"resume: validated {args.resume} "
+            f"(generation {gen}, depth {ck_depth})",
+            file=sys.stderr,
         )
+
+    # parent directories for artifact paths, so a fresh machine can point
+    # both at a not-yet-existing run directory
+    for _p in (args.checkpoint, args.metrics_out):
+        if _p:
+            _dn = os.path.dirname(_p)
+            if _dn:
+                os.makedirs(_dn, exist_ok=True)
+
     tel = None
     if (
         args.progress is not None or args.metrics_out is not None
@@ -452,20 +550,64 @@ def main(argv=None):
                 print(json.dumps(tel.last_summary))
         return rc
 
-    run_kw = {}
-    if args.checker in ("tpu", "sharded"):
-        run_kw = dict(
-            checkpoint_path=args.checkpoint,
-            checkpoint_every_s=args.checkpoint_every,
-            resume=args.resume,
-        )
-    res = checker.run(
+    from .resilience import PreemptionGuard
+    from .resilience.errors import (
+        CapacityOverflow,
+        CheckpointCorrupt,
+        CheckpointMismatch,
+        UnrecoverableError,
+    )
+
+    # all three BFS engines share the checkpoint/resume/preempt surface
+    run_kw = dict(
         max_depth=args.max_depth,
         verbose=args.verbose,
         time_budget_s=args.time_budget,
         telemetry=tel,
-        **run_kw,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every_s=args.checkpoint_every,
+        checkpoint_keep=args.checkpoint_keep,
+        resume=args.resume,
     )
+    if chaos_spec is not None:
+        # ONE injector for the whole session: each fault fires once even
+        # across supervisor attempts (a crash-at-wave-3 must not re-fire
+        # after the resume passes wave 3 again)
+        from .resilience import ChaosInjector
+
+        run_kw["chaos"] = ChaosInjector(chaos_spec)
+    guard = PreemptionGuard().install()
+    run_kw["preempt"] = guard
+    try:
+        if args.supervise is not None:
+            from .resilience import supervise
+
+            res = supervise(
+                make_checker,
+                run_kw,
+                max_retries=args.supervise,
+                seed=args.seed,
+                telemetry=tel,
+                verbose=args.verbose,
+            )
+        else:
+            res = checker.run(**run_kw)
+    except CheckpointMismatch as e:
+        print(f"error: {e}", file=sys.stderr)
+        return _finish(64)
+    except (CheckpointCorrupt, UnrecoverableError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return _finish(5)
+    except CapacityOverflow as e:
+        print(f"error: {e}", file=sys.stderr)
+        print(
+            "hint: re-run with --supervise (and --checkpoint PATH) to "
+            "auto-grow capacities and resume",
+            file=sys.stderr,
+        )
+        return _finish(5)
+    finally:
+        guard.uninstall()
     viol_name = (
         res.violation_invariant if args.checker == "sharded"
         else (res.violation.invariant if res.violation else None)
@@ -506,6 +648,17 @@ def main(argv=None):
                 print(format_trace(res.trace, setup))
         _print_coverage()  # violation rc 2 outranks the strict gate
         return _finish(2)
+    if getattr(res, "exit_cause", None) == "preempted":
+        # distinct rc so preemptible-TPU schedulers can tell "requeue
+        # me with --resume" (4) apart from clean completion (0)
+        print(
+            f"preempted ({guard.signame}): "
+            + (f"resumable checkpoint saved to {args.checkpoint}; "
+               f"re-run with --resume {args.checkpoint}"
+               if args.checkpoint
+               else "no --checkpoint was set, progress is lost")
+        )
+        return _finish(4)
     print("no invariant violations")
     cov_rc = _print_coverage()
 

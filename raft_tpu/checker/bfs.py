@@ -26,6 +26,8 @@ from ..obs import NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
+from ..resilience import ckpt as rckpt
+from ..resilience.errors import CapacityOverflow
 
 
 def _in_sorted(sorted_arr: np.ndarray, vals: np.ndarray) -> np.ndarray:
@@ -109,6 +111,9 @@ class CheckResult:
     # order (TLC -coverage analog); None for models without the
     # rank/name contract
     coverage: list[list[int]] | None = None
+    # why the run ended (obs.events.EXIT_CAUSES vocabulary); the CLI
+    # maps "preempted" to exit code 4
+    exit_cause: str | None = None
 
 
 class BFSChecker:
@@ -148,7 +153,13 @@ class BFSChecker:
         verbose: bool = False,
         time_budget_s: float | None = None,
         collect_metrics: bool = False,
+        checkpoint_path: str | None = None,
+        checkpoint_every_s: float = 300.0,
+        checkpoint_keep: int = rckpt.DEFAULT_KEEP,
+        resume: str | None = None,
         telemetry=None,
+        preempt=None,
+        chaos=None,
     ) -> CheckResult:
         model = self.model
         B = self.chunk
@@ -156,6 +167,8 @@ class BFSChecker:
         exhausted = True
         exit_cause = None
         tel = telemetry if telemetry is not None else NULL_TELEMETRY
+        self._ckpt_keep = checkpoint_keep
+        self._chaos = chaos
 
         init = model.init_states()
         n0 = len(init)
@@ -177,17 +190,85 @@ class BFSChecker:
         violation = None
         K = self.n_actions
         cov = np.zeros((K, 3), dtype=np.int64)  # [enabled, fired, new]/rank
-
-        viol = self._check_invariants(frontier, 0, 0)
-        if viol is not None:
-            violation = viol
-
-        tel.open_run(self._telemetry_manifest())
-        metrics: list[dict] | None = [] if collect_metrics else None
         depth = 0
         base_gid = 0  # global id of first state in current frontier
         next_gid = distinct
+
+        ck_gen = 0
+        ck_skipped: list[str] = []
+        if resume is not None:
+            # wave-boundary snapshot: the gid numbering below the saved
+            # frontier is deterministic from the model, so only the
+            # explored state (frontier/seen/journal/counters) reloads
+            ck, ck_gen, ck_skipped = rckpt.load_npz(
+                resume, keep=checkpoint_keep
+            )
+            rckpt.check_spec(ck, self._ckpt_ident(), resume)
+            frontier = np.asarray(ck["frontier"], dtype=np.int32)
+            seen = np.asarray(ck["seen"], dtype=np.uint64)
+            self._parents = [np.asarray(ck["parents"], dtype=np.int64)]
+            self._cands = [np.asarray(ck["cands"], dtype=np.int32)]
+            distinct = int(ck["distinct"])
+            total = int(ck["total"])
+            terminal = int(ck["terminal"])
+            depth = int(ck["depth"])
+            base_gid = int(ck["base_gid"])
+            next_gid = int(ck["next_gid"])
+            depth_counts = list(int(x) for x in ck["depth_counts"])
+            # coverage joined the format after version 1 shipped; older
+            # files resume with zeroed counters
+            cov = (
+                np.asarray(ck["coverage"], dtype=np.int64)
+                if "coverage" in ck
+                else np.zeros((K, 3), dtype=np.int64)
+            )
+        else:
+            viol = self._check_invariants(frontier, 0, 0)
+            if viol is not None:
+                violation = viol
+
+        tel.open_run(self._telemetry_manifest())
+        if resume is not None:
+            if ck_skipped:
+                tel.event(
+                    "ckpt_generation", path=resume, generation=ck_gen,
+                    skipped=list(ck_skipped),
+                )
+            tel.event(
+                "resume", path=resume, generation=ck_gen, depth=depth,
+                distinct=distinct,
+            )
+        metrics: list[dict] | None = [] if collect_metrics else None
+        last_ckpt = time.perf_counter()
         while len(frontier) and violation is None:
+            if preempt is not None and preempt.requested:
+                exhausted = False
+                exit_cause = "preempted"
+                tel.event(
+                    "preempt", signame=preempt.signame, depth=depth,
+                    checkpoint=checkpoint_path,
+                )
+                break
+            if chaos is not None:
+                chaos.wave_start(depth + 1)
+                inj = chaos.ovf_bits(0, depth + 1, 4)
+                if inj:
+                    # the host engine has no fixed frontier buffer, so a
+                    # spurious overflow still aborts at wave-start state
+                    # (the supervisor rebuilds with empty growth and
+                    # resumes) — exercising the same recovery path the
+                    # device engines take
+                    if checkpoint_path is not None:
+                        self._save_checkpoint(
+                            checkpoint_path, frontier, seen, distinct,
+                            total, terminal, depth, base_gid, next_gid,
+                            depth_counts, cov,
+                        )
+                    raise CapacityOverflow(
+                        "injected frontier overflow (chaos)",
+                        what=("frontier",), bits=int(inj),
+                        checkpoint_saved=checkpoint_path is not None,
+                    )
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
                 exit_cause = "max_depth"
@@ -234,8 +315,9 @@ class BFSChecker:
                         )
                     valid[nb:] = False
                     if np.any(valid & ovf):
-                        raise OverflowError(
-                            "message-slot overflow: re-run with a larger msg_slots"
+                        raise CapacityOverflow(
+                            "message-slot overflow: re-run with a larger msg_slots",
+                            what=("msg",), bits=1,
                         )
                     if K:
                         # numpy mirror of DeviceBFS._chunk_step 4b:
@@ -319,6 +401,16 @@ class BFSChecker:
             distinct += len(wave_states)
             prev_frontier = len(frontier)
             frontier = wave_states
+            if (
+                checkpoint_path is not None
+                and violation is None  # a saved file must not mask a violation
+                and time.perf_counter() - last_ckpt > checkpoint_every_s
+            ):
+                self._save_checkpoint(
+                    checkpoint_path, frontier, seen, distinct, total,
+                    terminal, depth, base_gid, next_gid, depth_counts, cov,
+                )
+                last_ckpt = time.perf_counter()
             if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
@@ -372,6 +464,15 @@ class BFSChecker:
                         file=sys.stderr,
                     )
 
+        if checkpoint_path is not None and violation is None and not exhausted:
+            # budget/depth/preemption exit at a wave boundary: save a
+            # final resumable snapshot (the periodic timer alone can
+            # leave no checkpoint at all on short-budget runs)
+            self._save_checkpoint(
+                checkpoint_path, frontier, seen, distinct, total,
+                terminal, depth, base_gid, next_gid, depth_counts, cov,
+            )
+
         dt = time.perf_counter() - t0
         if violation is not None:
             exit_cause = "violation"
@@ -413,6 +514,7 @@ class BFSChecker:
             trace=trace,
             metrics=metrics,
             coverage=[[int(x) for x in row] for row in cov] if K else None,
+            exit_cause=exit_cause,
         )
 
     def _fps_rows(self, rows: np.ndarray) -> np.ndarray:
@@ -449,6 +551,52 @@ class BFSChecker:
             "frontier_hist": [int(x) for x in depth_counts],
             "canon_memo_fill": None,  # host engine has no canon memo
         }
+
+    def grow_for_overflow(self, bits: int) -> dict | None:
+        """Supervisor growth policy. The host engine's buffers are
+        unbounded numpy arrays, so every recoverable overflow maps to
+        the empty override dict (rebuild identically, resume); only the
+        msg-slots bit — model shape, not engine capacity — is fatal."""
+        return None if int(bits) & 1 else {}
+
+    def _save_checkpoint(
+        self, path, frontier, seen, distinct, total, terminal, depth,
+        base_gid, next_gid, depth_counts, cov,
+    ):
+        """Wave-boundary snapshot via the crash-safe writer
+        (resilience/ckpt.py: tmp + fsync + rename, content hash,
+        generation rotation). The journal is flattened to two arrays;
+        resume reloads it as a single segment — _journal_lookup walks
+        segments, so a one-element list is equivalent."""
+        parents = (
+            np.concatenate(self._parents)
+            if self._parents else np.zeros(0, np.int64)
+        )
+        cands = (
+            np.concatenate(self._cands)
+            if self._cands else np.zeros(0, np.int32)
+        )
+        rckpt.save_npz(
+            path,
+            dict(
+                version=1,
+                spec=self._ckpt_ident(),
+                frontier=np.asarray(frontier, dtype=np.int32),
+                seen=np.asarray(seen, dtype=np.uint64),
+                parents=parents.astype(np.int64),
+                cands=cands.astype(np.int32),
+                distinct=distinct,
+                total=total,
+                terminal=terminal,
+                depth=depth,
+                base_gid=base_gid,
+                next_gid=next_gid,
+                depth_counts=np.asarray(depth_counts, dtype=np.int64),
+                coverage=np.asarray(cov, dtype=np.int64),
+            ),
+            keep=getattr(self, "_ckpt_keep", rckpt.DEFAULT_KEEP),
+            chaos=getattr(self, "_chaos", None),
+        )
 
     def _ckpt_ident(self) -> str:
         """Same identity grammar as the device engines (hashv marks the

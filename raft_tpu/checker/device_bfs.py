@@ -54,6 +54,8 @@ from ..obs import NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
+from ..resilience import ckpt as rckpt
+from ..resilience.errors import CapacityOverflow
 from .bfs import CheckResult, Violation
 from .lsm import CanonMemo, pow2_at_least
 from .util import (
@@ -92,6 +94,12 @@ class DeviceBFS:
 
     GROWTH = GROWTH
     HEADROOM = HEADROOM
+
+    # overflow-bit vocabulary (mirrors the in-program stats lane); the
+    # seen-set has no in-program bit — its host-side guard raises with
+    # this synthetic one so the supervisor's growth policy can key on it
+    OVF_NAMES = ((1, "msg"), (2, "valid"), (4, "frontier"), (8, "journal"))
+    SEEN_OVF_BIT = 16
 
     def __init__(
         self,
@@ -640,6 +648,31 @@ class DeviceBFS:
             self.JCAP = new
         return frontier, next_buf, jparent, jcand
 
+    def grow_for_overflow(self, bits: int) -> dict | None:
+        """Constructor overrides that would absorb the overflow ``bits``
+        of a CapacityOverflow raised by this instance — the supervisor's
+        regrow-and-resume policy. Returns None when a bit has no growth
+        story: msg-slots is model SHAPE (the bag width every state row
+        carries), not an engine buffer, so rebuilding the engine cannot
+        fix it — the model must be re-lowered with more slots."""
+        bits = int(bits)
+        if bits & 1:
+            return None
+        g: dict = {}
+        if bits & 2:
+            vps = max(1, -(-self.VC // self.chunk))
+            g["valid_per_state"] = min(self.A, vps * 2)
+            g["valid_per_group"] = None  # drop the tight budget plan
+        if bits & 4:
+            g["frontier_cap"] = self.FCAP * 2
+            g["max_frontier_cap"] = max(self.MAX_FCAP, self.FCAP * 4)
+        if bits & 8:
+            g["journal_cap"] = self.JCAP * 2
+            g["max_journal_cap"] = max(self.MAX_JCAP, self.JCAP * 4)
+        if bits & self.SEEN_OVF_BIT:
+            g["max_seen_cap"] = self.MAX_SCAP * 4
+        return g
+
     # ---------------- host driver ----------------
 
     def run(
@@ -650,8 +683,11 @@ class DeviceBFS:
         collect_metrics: bool = False,
         checkpoint_path: str | None = None,
         checkpoint_every_s: float = 300.0,
+        checkpoint_keep: int = rckpt.DEFAULT_KEEP,
         resume: str | None = None,
         telemetry=None,
+        preempt=None,
+        chaos=None,
     ) -> CheckResult:
         model = self.model
         C, W = self.chunk, self.W
@@ -663,6 +699,8 @@ class DeviceBFS:
         # adds no device syncs and stays bit-identical (tests/test_obs.py)
         tel = telemetry if telemetry is not None else NULL_TELEMETRY
         self._tel = tel
+        self._ckpt_keep = checkpoint_keep
+        self._chaos = chaos
 
         init = model.init_states()
         init_fps = np.asarray(
@@ -679,13 +717,17 @@ class DeviceBFS:
         assert n0 <= self.FCAP, "initial states exceed frontier_cap"
         self._init_distinct = init_d
 
+        ck_gen = 0
+        ck_skipped: list[str] = []
         if resume is not None:
-            ck = np.load(resume, allow_pickle=False)
+            # verified load with generation fallback: a truncated latest
+            # file falls back to the newest intact .genN and the skipped
+            # candidates surface as a ckpt_generation event below
+            ck, ck_gen, ck_skipped = rckpt.load_npz(
+                resume, keep=checkpoint_keep
+            )
             ident = self._ckpt_ident()
-            if str(ck["spec"]) != ident:
-                raise ValueError(
-                    f"checkpoint is for spec {ck['spec']}, model is {ident}"
-                )
+            rckpt.check_spec(ck, ident, resume)
             fcount = int(ck["fcount"])
             scount = int(ck["scount"])
             jcount = int(ck["jcount"])
@@ -706,14 +748,14 @@ class DeviceBFS:
             depth = int(ck["depth"])
             base_gid = int(ck["base_gid"])
             gen_prev = int(ck["gen_prev"])
-            depth_counts = list(ck["depth_counts"])
+            depth_counts = [int(x) for x in ck["depth_counts"]]
             stats0 = np.array([0, jcount, gen_prev, terminal, 0, 0],
                               dtype=np.int64)
             # coverage joined the checkpoint format after version 1
             # shipped; older files resume with zeroed counters
             cov_h = (
                 np.asarray(ck["coverage"], dtype=np.int64)
-                if "coverage" in ck.files
+                if "coverage" in ck
                 else np.zeros((self.n_actions, 3), np.int64)
             )
         else:
@@ -766,10 +808,33 @@ class DeviceBFS:
         memo_prev = 0
 
         tel.open_run(self._telemetry_manifest())
+        if resume is not None:
+            if ck_skipped:
+                tel.event(
+                    "ckpt_generation", path=resume, generation=ck_gen,
+                    skipped=list(ck_skipped),
+                )
+            tel.event(
+                "resume", path=resume, generation=ck_gen, depth=depth,
+                distinct=distinct,
+            )
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
 
         while fcount and violation is None:
+            if preempt is not None and preempt.requested:
+                # SIGTERM/SIGINT honored at the wave boundary: the final
+                # snapshot block below writes the checkpoint, the CLI
+                # maps exit_cause "preempted" to rc 4
+                exhausted = False
+                exit_cause = "preempted"
+                tel.event(
+                    "preempt", signame=preempt.signame, depth=depth,
+                    checkpoint=checkpoint_path,
+                )
+                break
+            if chaos is not None:
+                chaos.wave_start(depth + 1)
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
                 exit_cause = "max_depth"
@@ -790,8 +855,10 @@ class DeviceBFS:
                         fcount, scount, distinct, total, terminal,
                         depth, base_gid, gen_prev, depth_counts, cov_h,
                     )
-                raise OverflowError(
-                    "seen-set capacity overflow; raise max_seen_cap"
+                raise CapacityOverflow(
+                    "seen-set capacity overflow; raise max_seen_cap",
+                    what=("seen",), bits=self.SEEN_OVF_BIT,
+                    checkpoint_saved=checkpoint_path is not None,
                 )
             # a wave whose new count could outgrow even the MAXIMALLY
             # grown frontier will abort mid-wave (not resumable), so
@@ -833,6 +900,12 @@ class DeviceBFS:
             viol_h = np.asarray(viol_h)
             ncount = int(stats_h[0])
             ovf_bits = int(stats_h[4])
+            if chaos is not None:
+                # spurious frontier-overflow injection: the wave really
+                # completed, but we abort exactly as a real bit-4 would —
+                # the wave-start checkpoint below is still consistent
+                # because nothing (cov/seen/journal counts) was adopted
+                ovf_bits = chaos.ovf_bits(ovf_bits, depth + 1, 4)
             if ovf_bits:
                 saved = ""
                 if checkpoint_path is not None:
@@ -848,11 +921,17 @@ class DeviceBFS:
                         gen_prev, depth_counts, cov_h,
                     )
                     saved = f"; wave-start checkpoint saved to {checkpoint_path}"
-                raise OverflowError(
+                raise CapacityOverflow(
                     f"device BFS capacity overflow (bits={ovf_bits:04b}: "
                     "1=msg-slots 2=valid_per_state/valid_per_group "
                     "4=frontier_cap 8=journal_cap)"
-                    + saved
+                    + saved,
+                    what=tuple(
+                        name for bit, name in self.OVF_NAMES
+                        if ovf_bits & bit
+                    ),
+                    bits=ovf_bits,
+                    checkpoint_saved=checkpoint_path is not None,
                 )
             # the wave completed: adopt its cumulative coverage (the
             # aborted-wave path above deliberately keeps the wave-start
@@ -1038,6 +1117,7 @@ class DeviceBFS:
                 [[int(x) for x in row] for row in cov_h]
                 if self.n_actions else None
             ),
+            exit_cause=exit_cause,
         )
         return res
 
@@ -1122,36 +1202,37 @@ class DeviceBFS:
         total, terminal, depth, base_gid, gen_prev, depth_counts,
         coverage,
     ):
-        import os
-
         n0 = len(self._init_distinct)
         jcount = scount - n0
         seen = self._lsm_export()
         assert len(seen) == scount, f"LSM export {len(seen)} != scount {scount}"
-        tmp = f"{path}.tmp.npz"  # .npz suffix stops savez renaming it
-        # uncompressed: multi-GB checkpoints on a 1-core host must not
-        # stall the device loop for minutes of zlib
-        np.savez(
-            tmp,
-            version=1,
-            spec=self._ckpt_ident(),
-            fcount=fcount,
-            scount=scount,
-            jcount=jcount,
-            frontier=np.asarray(jax.device_get(frontier[:fcount])),
-            seen=seen,
-            jparent=np.asarray(jax.device_get(jparent[:jcount])),
-            jcand=np.asarray(jax.device_get(jcand[:jcount])),
-            distinct=distinct,
-            total=total,
-            terminal=terminal,
-            depth=depth,
-            base_gid=base_gid,
-            gen_prev=gen_prev,
-            depth_counts=np.asarray(depth_counts, dtype=np.int64),
-            coverage=np.asarray(coverage, dtype=np.int64),
+        # crash-safe write (resilience/ckpt.py): tmp + fsync + rename,
+        # format_version + content hash embedded, previous generations
+        # rotated so a torn write costs one interval, not the run
+        rckpt.save_npz(
+            path,
+            dict(
+                version=1,  # engine payload layout revision (unchanged)
+                spec=self._ckpt_ident(),
+                fcount=fcount,
+                scount=scount,
+                jcount=jcount,
+                frontier=np.asarray(jax.device_get(frontier[:fcount])),
+                seen=seen,
+                jparent=np.asarray(jax.device_get(jparent[:jcount])),
+                jcand=np.asarray(jax.device_get(jcand[:jcount])),
+                distinct=distinct,
+                total=total,
+                terminal=terminal,
+                depth=depth,
+                base_gid=base_gid,
+                gen_prev=gen_prev,
+                depth_counts=np.asarray(depth_counts, dtype=np.int64),
+                coverage=np.asarray(coverage, dtype=np.int64),
+            ),
+            keep=getattr(self, "_ckpt_keep", rckpt.DEFAULT_KEEP),
+            chaos=getattr(self, "_chaos", None),
         )
-        os.replace(tmp, path)
 
     def _check_init(self, init_d: np.ndarray) -> Violation | None:
         for name in self.invariants:

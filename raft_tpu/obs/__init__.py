@@ -15,11 +15,15 @@ and TLC-style per-action coverage rendering (coverage.py).
 from .collector import MetricsCollector, NULL_TELEMETRY, Telemetry
 from .coverage import coverage_digest, dead_actions, render_coverage_table
 from .events import (
+    CKPT_GENERATION_KEYS,
     COVERAGE_KEYS,
     DECLARED_EVENTS,
     EVENT_KEYS,
     EXIT_CAUSES,
     MANIFEST_KEYS,
+    PREEMPT_KEYS,
+    RESUME_KEYS,
+    RETRY_KEYS,
     STALL_KEYS,
     SUMMARY_KEYS,
     WAVE_KEYS,
@@ -31,11 +35,15 @@ from .progress import ProgressRenderer, format_count
 from .trace import TraceHooks
 
 __all__ = [
+    "CKPT_GENERATION_KEYS",
     "COVERAGE_KEYS",
     "DECLARED_EVENTS",
     "EVENT_KEYS",
     "EXIT_CAUSES",
     "MANIFEST_KEYS",
+    "PREEMPT_KEYS",
+    "RESUME_KEYS",
+    "RETRY_KEYS",
     "STALL_KEYS",
     "SUMMARY_KEYS",
     "WAVE_KEYS",

@@ -142,6 +142,16 @@ class MetricsCollector:
             self._last_skipped_cov = ev
         self._notify(ev)
 
+    def event(self, etype: str, **fields) -> None:
+        """Low-volume out-of-band event (the resilience events: retry,
+        resume, ckpt_generation, preempt). Always written — cadence is
+        for per-wave volume; a recovery narrative must never be
+        sampled away."""
+        assert etype in EVENT_KEYS, f"unknown event type {etype!r}"
+        ev = {"event": etype, **fields}
+        self._write(ev)
+        self._notify(ev)
+
     def summary(self, fields: dict) -> None:
         """Close a run: flush the newest skipped wave (the stream must
         end count-accurate at any cadence), emit the summary event."""
@@ -219,6 +229,9 @@ class Telemetry:
     def coverage(self, fields: dict, final: bool = False) -> None:
         self.collector.coverage(fields, final=final)
 
+    def event(self, etype: str, **fields) -> None:
+        self.collector.event(etype, **fields)
+
     def close_run(self, summary: dict) -> None:
         self.collector.summary(summary)
 
@@ -271,6 +284,9 @@ class _NullTelemetry:
         pass
 
     def coverage(self, fields: dict, final: bool = False) -> None:
+        pass
+
+    def event(self, etype: str, **fields) -> None:
         pass
 
     def close_run(self, summary: dict) -> None:

@@ -24,6 +24,12 @@ A run emits one JSON object per line (JSONL), in order:
   summary    once per run(), after the last wave: final counts, exit
              cause, peak buffer geometry, fleet memo hit rate.
 
+The self-healing runtime (raft_tpu/resilience/) adds four low-volume
+events — ``retry`` / ``resume`` / ``ckpt_generation`` / ``preempt`` —
+documented at their key tuples below; they interleave with the above
+(retry between attempts, resume/ckpt_generation right after a resumed
+run's manifest, preempt just before a "preempted" summary).
+
 ``DECLARED_EVENTS`` mirrors ``DECLARED_STAGES``: the tier-1 smoke test
 pins it, so the schema cannot silently rot when an engine's stats
 plumbing changes. Engines may add EXTRA keys (e.g. the sharded checker's
@@ -92,19 +98,58 @@ SUMMARY_KEYS = (
     "peak_journal_cap", "seen_lanes", "canon_memo_hit_rate",
 )
 
+# resilience events (self-healing runtime): the supervisor and the
+# engines narrate recovery in the same stream the waves go to, so a
+# chaos-ridden or preempted run is explicable from its JSONL alone.
+#   retry            emitted by the supervisor between attempts:
+#                    monotone ``attempt`` counter, classified ``cause``
+#                    (overflow:<what> / crash / transient / ckpt-load),
+#                    chosen ``backoff_s``, cumulative capacity
+#                    ``growth`` summary string ("-" when none).
+#   resume           emitted by an engine that restored state from a
+#                    checkpoint, before its first wave: which file,
+#                    which generation won, restored depth/distinct.
+#   ckpt_generation  emitted when load had to SKIP newer generations
+#                    (truncated/corrupt): the generation that verified
+#                    and one diagnostic line per rejected candidate.
+#   preempt          emitted when SIGTERM/SIGINT caused a wave-boundary
+#                    checkpoint-and-exit (summary follows with
+#                    exit_cause "preempted"; the CLI maps it to rc 4).
+RETRY_KEYS = (
+    "event", "attempt", "cause", "backoff_s", "growth",
+)
+
+RESUME_KEYS = (
+    "event", "path", "generation", "depth", "distinct",
+)
+
+CKPT_GENERATION_KEYS = (
+    "event", "path", "generation", "skipped",
+)
+
+PREEMPT_KEYS = (
+    "event", "signame", "depth", "checkpoint",
+)
+
 DECLARED_EVENTS = (
     ("manifest", MANIFEST_KEYS),
     ("wave", WAVE_KEYS),
     ("stall", STALL_KEYS),
     ("coverage", COVERAGE_KEYS),
     ("summary", SUMMARY_KEYS),
+    ("retry", RETRY_KEYS),
+    ("resume", RESUME_KEYS),
+    ("ckpt_generation", CKPT_GENERATION_KEYS),
+    ("preempt", PREEMPT_KEYS),
 )
 
 EVENT_KEYS = dict(DECLARED_EVENTS)
 
-# exit causes a summary event may carry (one run, one cause)
+# exit causes a summary event may carry (one run, one cause);
+# "preempted" = SIGTERM/SIGINT honored at a wave boundary with a
+# checkpoint written (restart with --resume loses nothing)
 EXIT_CAUSES = (
-    "exhausted", "violation", "max_depth", "time_budget",
+    "exhausted", "violation", "max_depth", "time_budget", "preempted",
 )
 
 
@@ -157,6 +202,34 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
             f"{where}summary exit_cause {ev.get('exit_cause')!r} not in "
             f"{EXIT_CAUSES}"
         )
+    if etype == "retry":
+        att = ev.get("attempt")
+        if isinstance(att, bool) or not isinstance(att, int) or att < 1:
+            problems.append(
+                f"{where}retry attempt {att!r} must be an int >= 1"
+            )
+        back = ev.get("backoff_s")
+        if isinstance(back, bool) or not isinstance(back, (int, float)) \
+                or back < 0:
+            problems.append(
+                f"{where}retry backoff_s {back!r} must be a non-negative "
+                f"number"
+            )
+    if etype in ("resume", "ckpt_generation"):
+        gen = ev.get("generation")
+        if isinstance(gen, bool) or not isinstance(gen, int) or gen < 0:
+            problems.append(
+                f"{where}{etype} generation {gen!r} must be an int >= 0"
+            )
+        if etype == "ckpt_generation":
+            sk = ev.get("skipped")
+            if not isinstance(sk, list) or any(
+                not isinstance(s, str) for s in sk
+            ):
+                problems.append(
+                    f"{where}ckpt_generation skipped must be a list of "
+                    f"diagnostic strings"
+                )
     if etype == "coverage":
         acts = ev.get("actions")
         if not isinstance(acts, list) or any(
@@ -186,7 +259,10 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     come after its waves; coverage events must come before the run's
     summary, carry non-decreasing wave indices (the final snapshot may
     repeat the last wave), and their cumulative per-action counters
-    must be monotone non-decreasing cell-by-cell.
+    must be monotone non-decreasing cell-by-cell. Supervisor ``retry``
+    attempts must be strictly increasing across a supervised session (a
+    summary ends the session and resets the counter — a completed run
+    means any later retry belongs to a new invocation).
     """
     counts: dict[str, int] = {}
     problems: list[str] = []
@@ -194,6 +270,7 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     summarized = False
     last_cov_wave = 0
     prev_actions: list | None = None
+    last_retry_attempt = 0
     for lineno, raw in enumerate(lines, start=1):
         raw = raw.strip()
         if not raw:
@@ -253,6 +330,17 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
                 )
             else:
                 last_wave = w
+        elif etype == "retry":
+            att = ev.get("attempt")
+            if isinstance(att, int) and not isinstance(att, bool):
+                if att <= last_retry_attempt:
+                    problems.append(
+                        f"line {lineno}: retry attempt {att} not strictly "
+                        f"increasing (previous {last_retry_attempt})"
+                    )
+                else:
+                    last_retry_attempt = att
         elif etype == "summary":
             summarized = True
+            last_retry_attempt = 0
     return counts, problems

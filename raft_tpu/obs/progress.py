@@ -75,6 +75,38 @@ class ProgressRenderer:
                 file=self.stream, flush=True,
             )
             return
+        if etype == "retry":
+            print(
+                f"Recovery: attempt {ev['attempt']} failed ({ev['cause']}); "
+                f"retrying in {ev['backoff_s']:.1f}s"
+                + ("" if ev.get("growth") in (None, "-")
+                   else f", growing {ev['growth']}"),
+                file=self.stream, flush=True,
+            )
+            return
+        if etype == "resume":
+            print(
+                f"Resumed from {ev['path']} (generation "
+                f"{ev['generation']}) at depth {ev['depth']}, "
+                f"{format_count(ev['distinct'])} distinct",
+                file=self.stream, flush=True,
+            )
+            return
+        if etype == "ckpt_generation":
+            print(
+                f"Warning: {len(ev['skipped'])} corrupt checkpoint "
+                f"generation(s) skipped; loaded generation "
+                f"{ev['generation']} of {ev['path']}",
+                file=self.stream, flush=True,
+            )
+            return
+        if etype == "preempt":
+            print(
+                f"Preempted ({ev['signame']}): checkpoint written to "
+                f"{ev['checkpoint']} at depth {ev['depth']}",
+                file=self.stream, flush=True,
+            )
+            return
         if etype != "wave":
             return
         now = time.monotonic()

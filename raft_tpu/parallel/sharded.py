@@ -71,6 +71,8 @@ from ..ops.hashing import (
     U64_MAX, eq_u64, ne_u64, sort_u64, sort_u64_with_idx, split_u64,
 )
 from ..ops.symmetry import Canonicalizer
+from ..resilience import ckpt as rckpt
+from ..resilience.errors import CapacityOverflow
 
 AXIS = "shards"
 
@@ -94,6 +96,9 @@ class ShardedResult:
     # fleet-summed per-action [enabled, fired, new-distinct] in
     # ACTION_NAMES rank order; None for models without the contract
     coverage: list[list[int]] | None = None
+    # why the run ended (obs.events.EXIT_CAUSES vocabulary); the CLI
+    # maps "preempted" to exit code 4
+    exit_cause: str | None = None
 
 
 class ShardedBFS:
@@ -113,6 +118,14 @@ class ShardedBFS:
 
     GROWTH = GROWTH
     HEADROOM = HEADROOM
+    # overflow-bit vocabulary for the stats word (chunk-step assembly);
+    # SEEN_OVF_BIT is synthetic — the host TOPSZ guard raises it, the
+    # device never sets it
+    OVF_NAMES = (
+        (1, "msg"), (2, "valid"), (4, "route"), (8, "frontier"),
+        (16, "journal"),
+    )
+    SEEN_OVF_BIT = 32
 
     def __init__(
         self,
@@ -507,6 +520,31 @@ class ShardedBFS:
             self.JCAP = new
         return state
 
+    def grow_for_overflow(self, bits: int) -> dict | None:
+        """Constructor-kwarg overrides that would clear the overflow
+        bits on a rebuilt engine, or None if no growth can help (the
+        supervisor then reports the failure as unrecoverable). Mirrors
+        DeviceBFS.grow_for_overflow; route_cap is the sharded-only knob."""
+        bits = int(bits)
+        if bits & 1:
+            return None  # msg-slot width is a model property, not a cap
+        growth: dict = {}
+        if bits & 2:
+            vps = min(self.A, -(-self.VC // self.chunk) * 2)
+            growth["valid_per_state"] = vps
+            growth["valid_per_group"] = None
+        if bits & 4:
+            growth["route_cap"] = self.RC * 2
+        if bits & 8:
+            growth["frontier_cap"] = self.FCAP * 2
+            growth["max_frontier_cap"] = max(self.MAX_FCAP, self.FCAP * 4)
+        if bits & 16:
+            growth["journal_cap"] = self.JCAP * 2
+            growth["max_journal_cap"] = max(self.MAX_JCAP, self.JCAP * 4)
+        if bits & self.SEEN_OVF_BIT:
+            growth["max_seen_cap"] = self.MAX_SCAP * 4
+        return growth or None
+
     # ---------------- checkpoint ----------------
 
     def _ckpt_ident(self) -> str:
@@ -539,29 +577,34 @@ class ShardedBFS:
         for d, s in enumerate(seen):
             seen_h[d, : len(s)] = s
         frontier_h = np.asarray(jax.device_get(state["frontier"]))[:, :fmax]
-        tmp = f"{path}.tmp.npz"
-        np.savez(
-            tmp,
-            version=1,
-            spec=self._ckpt_ident(),
-            fcounts=fcounts, scounts=scounts, jcounts=jcounts,
-            n0=n0, base_lgid=base_lgid,
-            frontier=frontier_h,
-            seen=seen_h,
-            jps=np.asarray(jax.device_get(state["jps"]))[:, :jmax],
-            jpl=np.asarray(jax.device_get(state["jpl"]))[:, :jmax],
-            jcand=np.asarray(jax.device_get(state["jcand"]))[:, :jmax],
-            init_by_shard_flat=np.concatenate(
-                [np.stack(s) if s else np.zeros((0, self.W), np.int32)
-                 for s in self._init_by_shard], axis=0),
-            init_by_shard_count=np.asarray(
-                [len(s) for s in self._init_by_shard], np.int64),
-            distinct=distinct, total=total, terminal=terminal, depth=depth,
-            gen_prev=gen_prev, routed_prev=routed_prev,
-            depth_counts=np.asarray(depth_counts, dtype=np.int64),
-            coverage=np.asarray(coverage, dtype=np.int64),
+        # crash-safe write (resilience/ckpt.py): tmp + fsync + rename,
+        # content hash + format version, generation rotation
+        rckpt.save_npz(
+            path,
+            dict(
+                version=1,
+                spec=self._ckpt_ident(),
+                fcounts=fcounts, scounts=scounts, jcounts=jcounts,
+                n0=n0, base_lgid=base_lgid,
+                frontier=frontier_h,
+                seen=seen_h,
+                jps=np.asarray(jax.device_get(state["jps"]))[:, :jmax],
+                jpl=np.asarray(jax.device_get(state["jpl"]))[:, :jmax],
+                jcand=np.asarray(jax.device_get(state["jcand"]))[:, :jmax],
+                init_by_shard_flat=np.concatenate(
+                    [np.stack(s) if s else np.zeros((0, self.W), np.int32)
+                     for s in self._init_by_shard], axis=0),
+                init_by_shard_count=np.asarray(
+                    [len(s) for s in self._init_by_shard], np.int64),
+                distinct=distinct, total=total, terminal=terminal,
+                depth=depth,
+                gen_prev=gen_prev, routed_prev=routed_prev,
+                depth_counts=np.asarray(depth_counts, dtype=np.int64),
+                coverage=np.asarray(coverage, dtype=np.int64),
+            ),
+            keep=getattr(self, "_ckpt_keep", rckpt.DEFAULT_KEEP),
+            chaos=getattr(self, "_chaos", None),
         )
-        os.replace(tmp, path)
 
     # ---------------- host driver ----------------
 
@@ -573,13 +616,18 @@ class ShardedBFS:
         collect_metrics: bool = False,
         checkpoint_path: str | None = None,
         checkpoint_every_s: float = 300.0,
+        checkpoint_keep: int = rckpt.DEFAULT_KEEP,
         resume: str | None = None,
         telemetry=None,
+        preempt=None,
+        chaos=None,
     ) -> ShardedResult:
         model, D, W, C = self.model, self.D, self.W, self.chunk
         t0 = time.perf_counter()
         exhausted = True
         exit_cause = None
+        self._ckpt_keep = checkpoint_keep
+        self._chaos = chaos
         # telemetry rides the once-per-wave stats fetch the loop already
         # does — zero extra collectives or device syncs
         tel = telemetry if telemetry is not None else NULL_TELEMETRY
@@ -600,13 +648,13 @@ class ShardedBFS:
         viol_site = None  # (shard, lgid)
         init_trace = None  # one-entry trace for a depth-0 violation
 
+        ck_gen = 0
+        ck_skipped: list[str] = []
         if resume is not None:
-            ck = np.load(resume, allow_pickle=False)
+            ck, ck_gen, ck_skipped = rckpt.load_npz(
+                resume, keep=checkpoint_keep)
             ident = self._ckpt_ident()
-            if str(ck["spec"]) != ident:
-                raise ValueError(
-                    f"checkpoint is for spec {ck['spec']}, checker is {ident}"
-                )
+            rckpt.check_spec(ck, ident, resume)
             fcounts = np.asarray(ck["fcounts"], np.int64)
             scounts = np.asarray(ck["scounts"], np.int64)
             jcounts = np.asarray(ck["jcounts"], np.int64)
@@ -641,11 +689,11 @@ class ShardedBFS:
             depth = int(ck["depth"])
             gen_prev = int(ck["gen_prev"])
             routed_prev = int(ck["routed_prev"])
-            depth_counts = list(ck["depth_counts"])
+            depth_counts = [int(x) for x in ck["depth_counts"]]
             # pre-coverage checkpoints resume with zeroed counters
             cov_hd = (
                 np.asarray(ck["coverage"], dtype=np.int64)
-                if "coverage" in ck.files
+                if "coverage" in ck
                 else np.zeros((D, self.n_actions, 3), np.int64)
             )
             # per-shard generated/terminal/routed cums are not persisted
@@ -723,6 +771,14 @@ class ShardedBFS:
             cov_hd = np.zeros((D, self.n_actions, 3), np.int64)
 
         tel.open_run(self._telemetry_manifest())
+        if resume is not None:
+            if ck_skipped:
+                tel.event(
+                    "ckpt_generation", path=resume, generation=ck_gen,
+                    skipped=list(ck_skipped))
+            tel.event(
+                "resume", path=resume, generation=ck_gen, depth=depth,
+                distinct=distinct)
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
         # fresh per-shard memo per run: a pure cache, but starting empty
@@ -733,6 +789,17 @@ class ShardedBFS:
         per_shard_memo = np.zeros(D, np.int64)
 
         while fcounts.sum() and violation is None:
+            if preempt is not None and preempt.requested:
+                # the final-save block below writes the (single)
+                # wave-boundary checkpoint for this exit path
+                exhausted = False
+                exit_cause = "preempted"
+                tel.event(
+                    "preempt", signame=preempt.signame, depth=depth,
+                    checkpoint=checkpoint_path)
+                break
+            if chaos is not None:
+                chaos.wave_start(depth + 1)
             if max_depth is not None and depth >= max_depth:
                 exhausted = False
                 exit_cause = "max_depth"
@@ -755,8 +822,10 @@ class ShardedBFS:
                         gen_prev + gen_base, routed_prev + routed_base,
                         depth_counts, cov_hd,
                     )
-                raise OverflowError(
-                    "sharded seen-set capacity overflow; raise max_seen_cap"
+                raise CapacityOverflow(
+                    "sharded seen-set capacity overflow; raise max_seen_cap",
+                    what=("seen",), bits=self.SEEN_OVF_BIT,
+                    checkpoint_saved=checkpoint_path is not None,
                 )
             tw = time.perf_counter()
             fc_dev = jax.device_put(
@@ -789,11 +858,25 @@ class ShardedBFS:
             viol_h = np.asarray(viol_h)  # [D,K]
             new_d = stats_h[:, 0]
             ovf_bits = int(np.bitwise_or.reduce(stats_h[:, 4]))
+            if chaos is not None:
+                ovf_bits = chaos.ovf_bits(ovf_bits, depth + 1, 8)
             if ovf_bits:
-                raise OverflowError(
+                # unlike DeviceBFS, no wave-start checkpoint can be
+                # written here: the chunk loop already inserted this
+                # wave's fingerprints into the LSM, so an export would
+                # not match the wave-start scounts. The supervisor
+                # resumes from the last periodic checkpoint (or fresh
+                # with grown caps) — both are sound, just re-explore.
+                raise CapacityOverflow(
                     f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
                     "1=msg-slots 2=valid_per_state/valid_per_group "
-                    "4=route_cap 8=frontier_cap 16=journal_cap)")
+                    "4=route_cap 8=frontier_cap 16=journal_cap)",
+                    what=tuple(
+                        name for bit, name in self.OVF_NAMES
+                        if ovf_bits & bit),
+                    bits=ovf_bits,
+                    checkpoint_saved=False,
+                )
             # commit only after the ovf check: an aborted wave keeps the
             # wave-start counters (consistent with what a checkpoint saved)
             cov_hd = np.asarray(cov_w, dtype=np.int64)
@@ -1001,6 +1084,7 @@ class ShardedBFS:
             metrics=metrics,
             stats=fleet_stats,
             coverage=(fleet_stats["coverage"] if self.n_actions else None),
+            exit_cause=exit_cause,
         )
 
     def _coverage_fields(self, depth, cov_hd, scounts, depth_counts) -> dict:

@@ -1,0 +1,57 @@
+"""Self-healing runtime: crash-safe checkpoints, fault classification,
+auto-resume supervision, preemption handling and deterministic chaos.
+
+See the individual modules for the design notes; README "Fault
+tolerance & resume" has the operator-facing story.
+"""
+
+from .chaos import ChaosInjector, ChaosSpec
+from .ckpt import (
+    DEFAULT_KEEP,
+    FORMAT_VERSION,
+    check_spec,
+    content_hash,
+    format_version_of,
+    generation_path,
+    load_npz,
+    save_npz,
+    validate_resume,
+)
+from .errors import (
+    CapacityOverflow,
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointMismatch,
+    InjectedCrash,
+    InjectedTransient,
+    UnrecoverableError,
+    is_transient,
+)
+from .preempt import PreemptionGuard
+from .supervisor import DEFAULT_MAX_RETRIES, has_checkpoint, supervise
+
+__all__ = [
+    "CapacityOverflow",
+    "ChaosInjector",
+    "ChaosSpec",
+    "CheckpointCorrupt",
+    "CheckpointError",
+    "CheckpointMismatch",
+    "DEFAULT_KEEP",
+    "DEFAULT_MAX_RETRIES",
+    "FORMAT_VERSION",
+    "InjectedCrash",
+    "InjectedTransient",
+    "PreemptionGuard",
+    "UnrecoverableError",
+    "check_spec",
+    "content_hash",
+    "format_version_of",
+    "generation_path",
+    "has_checkpoint",
+    "is_transient",
+    "load_npz",
+    "save_npz",
+    "supervise",
+    "validate_resume",
+]

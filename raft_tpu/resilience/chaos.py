@@ -1,0 +1,151 @@
+"""Deterministic fault injection for the self-healing runtime.
+
+A resilience story nobody can exercise is a resilience story that rots.
+This harness turns "what if the process dies at wave 3 and the latest
+checkpoint write was torn" into a one-flag reproducible run:
+
+    raft_tpu raft.cfg --supervise --checkpoint ck.npz \
+        --chaos crash=3,truncate=2,ovf=4,seed=7
+
+Spec grammar (comma-separated ``key=int`` pairs, each fault fires once):
+
+  crash=K      raise InjectedCrash at the start of wave K (process-death
+               stand-in; the supervisor rebuilds and resumes)
+  transient=K  raise InjectedTransient at the start of wave K (flaky
+               dispatch stand-in; retried with backoff, same engine)
+  ovf=K        OR a spurious frontier-overflow bit into wave K's overflow
+               word, forcing the abort-with-wave-start-checkpoint path
+               and the supervisor's grow-and-resume policy
+  truncate=N   tear the N-th checkpoint write (truncate the published
+               file to a third) so load must fall back a generation
+  preempt=K    deliver a real SIGTERM to this process at the start of
+               wave K, exercising the actual signal handler and the
+               rc-4 checkpoint-at-wave-boundary path
+  seed=S       seeds the truncation cut point; recorded so a chaos run
+               is reproducible from its command line alone
+
+Hooks are called from engine wave loops (``wave_start``, ``ovf_bits``)
+and from ``ckpt.save_npz`` (``checkpoint_written``). One injector
+instance is shared across supervisor attempts, so a consumed fault
+never re-fires after recovery — which is what lets the parity tests
+assert the chaos run's final counts equal the fault-free run's.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+
+from .errors import InjectedCrash, InjectedTransient
+
+_KEYS = ("crash", "transient", "ovf", "truncate", "preempt", "seed")
+
+
+class ChaosSpec:
+    """Parsed, validated ``--chaos`` specification."""
+
+    def __init__(self, crash=None, transient=None, ovf=None,
+                 truncate=None, preempt=None, seed=0):
+        self.crash = crash
+        self.transient = transient
+        self.ovf = ovf
+        self.truncate = truncate
+        self.preempt = preempt
+        self.seed = int(seed)
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosSpec":
+        kw = {}
+        for part in filter(None, (p.strip() for p in text.split(","))):
+            key, eq, val = part.partition("=")
+            if not eq or key not in _KEYS:
+                raise ValueError(
+                    f"bad chaos spec element {part!r}: expected key=int with "
+                    f"key in {_KEYS}"
+                )
+            try:
+                ival = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"bad chaos spec element {part!r}: {val!r} is not an int"
+                ) from None
+            if key != "seed" and ival < 1:
+                raise ValueError(
+                    f"bad chaos spec element {part!r}: wave/count must be >= 1"
+                )
+            if key in kw:
+                raise ValueError(f"duplicate chaos spec key {key!r}")
+            kw[key] = ival
+        return cls(**kw)
+
+    def __str__(self):
+        parts = [f"{k}={getattr(self, k)}" for k in _KEYS[:-1]
+                 if getattr(self, k) is not None]
+        parts.append(f"seed={self.seed}")
+        return ",".join(parts)
+
+
+class ChaosInjector:
+    """Executes a ChaosSpec. Each fault is consumed exactly once across
+    the lifetime of THIS object — share one injector across supervisor
+    retries so recovery runs re-execute the faulted wave cleanly."""
+
+    def __init__(self, spec: ChaosSpec):
+        self.spec = spec
+        self._rng = random.Random(spec.seed)
+        self._pending = {
+            k: getattr(spec, k)
+            for k in ("crash", "transient", "ovf", "preempt")
+            if getattr(spec, k) is not None
+        }
+        self._writes_seen = 0
+        self._truncate_at = spec.truncate
+        self.fired: list[str] = []
+
+    def _consume(self, key: int) -> bool:
+        if key in self._pending:
+            del self._pending[key]
+            self.fired.append(key)
+            return True
+        return False
+
+    # --- engine hooks -------------------------------------------------
+
+    def wave_start(self, wave: int) -> None:
+        """Called at the top of each wave with the 1-based wave number
+        about to be explored. May raise or signal; ordering is
+        preempt < crash < transient when several target the same wave
+        (a SIGTERM only sets a flag, so it composes with the others)."""
+        if self._pending.get("preempt") == wave and self._consume("preempt"):
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._pending.get("crash") == wave and self._consume("crash"):
+            raise InjectedCrash(f"chaos: injected crash at wave {wave}")
+        if self._pending.get("transient") == wave and self._consume("transient"):
+            raise InjectedTransient(
+                f"chaos: injected transient dispatch failure at wave {wave}"
+            )
+
+    def ovf_bits(self, bits: int, wave: int, frontier_bit: int) -> int:
+        """Called with the wave's fetched overflow word; ORs in a
+        spurious frontier-capacity bit once at the configured wave."""
+        if self._pending.get("ovf") == wave and self._consume("ovf"):
+            return int(bits) | int(frontier_bit)
+        return int(bits)
+
+    def checkpoint_written(self, path: str) -> None:
+        """Called by ckpt.save_npz after each successful publish; tears
+        the configured N-th write by truncating the file partway."""
+        if self._truncate_at is None:
+            return
+        self._writes_seen += 1
+        if self._writes_seen != self._truncate_at:
+            return
+        self._truncate_at = None
+        self.fired.append("truncate")
+        size = os.path.getsize(path)
+        # cut somewhere in the middle third: enough bytes survive that
+        # np.load gets past the magic, not enough that the hash verifies
+        cut = max(1, size // 3 + self._rng.randrange(max(1, size // 3)))
+        with open(path, "r+b") as fh:
+            fh.truncate(cut)

@@ -1,0 +1,95 @@
+"""Typed failure taxonomy of the self-healing runtime.
+
+The supervisor (resilience/supervisor.py) retries on *classes*, not on
+string-matched messages, so every abort path an engine can take gets a
+type here. Subclassing keeps old callers working: ``CapacityOverflow``
+IS-A ``OverflowError`` (every pre-existing ``pytest.raises(OverflowError)``
+still passes) and ``CheckpointMismatch`` IS-A ``ValueError`` (the
+"checkpoint is for spec ..." contract tests keep matching).
+"""
+
+from __future__ import annotations
+
+
+class CapacityOverflow(OverflowError):
+    """A static device capacity was exceeded mid-run.
+
+    ``what`` names the offending capacities (subset of ``frontier``,
+    ``journal``, ``valid``, ``route``, ``msg``, ``seen``), derived from
+    the engine's overflow bits. ``bits`` keeps the raw engine-specific
+    bit vector for the message. ``checkpoint_saved`` is True when the
+    engine spilled a resumable wave-start checkpoint before raising —
+    the supervisor only regrows-and-resumes when it did.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        what: tuple[str, ...] = (),
+        bits: int = 0,
+        checkpoint_saved: bool = False,
+    ):
+        super().__init__(message)
+        self.what = tuple(what)
+        self.bits = int(bits)
+        self.checkpoint_saved = bool(checkpoint_saved)
+
+
+class CheckpointError(RuntimeError):
+    """Base for any checkpoint load/save problem."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """No intact generation could be loaded (truncation, hash mismatch,
+    unreadable zip). ``problems`` lists one line per rejected candidate
+    so the operator sees exactly what was tried."""
+
+    def __init__(self, message: str, problems: tuple[str, ...] = ()):
+        super().__init__(message)
+        self.problems = tuple(problems)
+
+
+class CheckpointMismatch(CheckpointError, ValueError):
+    """The checkpoint loaded fine but belongs to a different spec/format
+    (wrong model ident, wrong mesh, future format version). Resuming
+    would be unsound, never merely slow — no retry."""
+
+
+class InjectedCrash(RuntimeError):
+    """Deterministic fault from the chaos harness standing in for a
+    process death (power loss, OOM-kill, TPU preemption without grace)."""
+
+
+class InjectedTransient(RuntimeError):
+    """Deterministic fault standing in for a transient device/dispatch
+    error (flaky ICI link, one-off XLA runtime error) — the class the
+    supervisor retries with backoff WITHOUT rebuilding capacities."""
+
+
+class UnrecoverableError(RuntimeError):
+    """The supervisor exhausted its retry budget (or hit a failure with
+    no recovery policy). Carries the last underlying failure as
+    ``__cause__``; the CLI maps this to exit code 5."""
+
+
+# exception type NAMES treated as transient device/dispatch failures
+# (matched by name so importing jaxlib internals is not required; a
+# rebuilt engine + resume is the correct response to all of them)
+TRANSIENT_TYPE_NAMES = (
+    "XlaRuntimeError",
+    "InternalError",
+    "UnavailableError",
+    "JaxRuntimeError",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient device/dispatch failures: retry with backoff, same
+    capacities. Anything raised by the chaos harness's transient hook
+    counts by construction."""
+    if isinstance(exc, InjectedTransient):
+        return True
+    for klass in type(exc).__mro__:
+        if klass.__name__ in TRANSIENT_TYPE_NAMES:
+            return True
+    return False

@@ -1,0 +1,174 @@
+"""Auto-resume supervisor: wraps ``engine.run()`` and turns hard aborts
+into classified, bounded recovery.
+
+The BFS engines stay simple and fail loudly — capacity overflow,
+device flakes and torn checkpoints all raise. This driver owns the
+policy layer TLC keeps in its outer loop:
+
+  CapacityOverflow   -> ask the engine for a growth policy for the
+                        offending bits (``grow_for_overflow``), rebuild
+                        with the grown capacities, resume from the
+                        wave-start checkpoint the engine saved before
+                        raising. Bits with no growth story (msg-slots
+                        is model shape, not buffer size) stay fatal.
+  transient/crash    -> exponential backoff + seeded jitter, rebuild a
+                        fresh engine, resume from the newest intact
+                        checkpoint generation.
+  CheckpointCorrupt  -> when OUR resume checkpoint won't load, fall
+                        back to a fresh start (correct, just slower).
+  CheckpointMismatch -> unsound to resume; fatal immediately.
+  exit_cause
+    == "preempted"   -> not a failure: return the result, the CLI maps
+                        it to rc 4 and the scheduler restarts us.
+
+Because each resume starts from a wave-start checkpoint of engines
+whose exploration is deterministic, a supervised chaos-ridden run ends
+with final counts bit-identical to a fault-free run — pinned by the
+parity tests in tests/test_resilience.py.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from .ckpt import DEFAULT_KEEP, generation_path
+from .errors import (
+    CapacityOverflow,
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointMismatch,
+    InjectedCrash,
+    UnrecoverableError,
+    is_transient,
+)
+
+DEFAULT_MAX_RETRIES = 5
+
+
+def has_checkpoint(path: str | None, keep: int = DEFAULT_KEEP) -> bool:
+    """True when any generation of ``path`` exists on disk."""
+    if not path:
+        return False
+    return any(
+        os.path.exists(generation_path(path, g)) for g in range(max(1, keep))
+    )
+
+
+def _growth_summary(overrides: dict) -> str:
+    return ",".join(f"{k}={overrides[k]}" for k in sorted(overrides)) or "-"
+
+
+def supervise(
+    engine_factory,
+    run_kw: dict,
+    *,
+    max_retries: int = DEFAULT_MAX_RETRIES,
+    backoff_base: float = 0.5,
+    backoff_max: float = 30.0,
+    seed: int = 0,
+    telemetry=None,
+    verbose: bool = False,
+):
+    """Run ``engine_factory(overrides).run(**run_kw)`` to completion.
+
+    ``engine_factory`` builds a FRESH engine from a dict of constructor
+    overrides (empty on the first attempt; grown capacities after an
+    overflow). ``run_kw`` must route checkpoints (``checkpoint_path``)
+    for any recovery beyond pure transient-retry to be possible; the
+    supervisor flips its ``resume`` to the newest intact generation on
+    each recovery attempt. ``max_retries`` bounds RECOVERIES, not
+    attempts: attempt 1 is free, and every classified failure after it
+    consumes one retry.
+
+    Returns whatever ``engine.run`` returns. Raises UnrecoverableError
+    (with the last failure as ``__cause__``) when the budget is spent
+    or a failure has no recovery policy.
+    """
+    rng = random.Random(seed)
+    run_kw = dict(run_kw)
+    ckpt_path = run_kw.get("checkpoint_path")
+    keep = int(run_kw.get("checkpoint_keep", DEFAULT_KEEP) or DEFAULT_KEEP)
+    overrides: dict = {}
+    attempt = 0
+    retries_left = int(max_retries)
+
+    def _emit_retry(cause: str, backoff_s: float):
+        if telemetry is not None:
+            telemetry.event(
+                "retry",
+                attempt=attempt,
+                cause=cause,
+                backoff_s=round(float(backoff_s), 3),
+                growth=_growth_summary(overrides),
+            )
+        if verbose:
+            print(
+                f"[supervisor] attempt {attempt} failed ({cause}); "
+                f"retrying in {backoff_s:.1f}s"
+                + (f" with growth {_growth_summary(overrides)}"
+                   if overrides else "")
+            )
+
+    def _backoff() -> float:
+        if backoff_base <= 0:
+            return 0.0
+        raw = min(backoff_max, backoff_base * (2.0 ** (attempt - 1)))
+        return raw * (0.5 + 0.5 * rng.random())
+
+    def _spend(exc: BaseException, cause: str):
+        nonlocal retries_left
+        if retries_left <= 0:
+            raise UnrecoverableError(
+                f"retry budget exhausted after {attempt} attempts "
+                f"(last failure: {type(exc).__name__}: {exc})"
+            ) from exc
+        retries_left -= 1
+        delay = _backoff()
+        _emit_retry(cause, delay)
+        if delay > 0:
+            time.sleep(delay)
+
+    while True:
+        attempt += 1
+        engine = engine_factory(dict(overrides))
+        try:
+            result = engine.run(**run_kw)
+        except CapacityOverflow as exc:
+            growth = engine.grow_for_overflow(exc.bits)
+            if growth is None:
+                raise UnrecoverableError(
+                    f"capacity overflow with no growth policy "
+                    f"(bits={exc.bits:#x}, what={exc.what}): {exc}"
+                ) from exc
+            _spend(exc, f"overflow:{'+'.join(exc.what) or exc.bits}")
+            overrides.update(growth)
+            # resume from the newest checkpoint when one exists; the
+            # sharded engine cannot write a wave-start checkpoint at its
+            # abort point (the LSM already holds the aborted wave's
+            # fingerprints), so a fresh start with grown caps is the
+            # fallback — sound, just re-explores
+            run_kw["resume"] = (
+                ckpt_path
+                if exc.checkpoint_saved or has_checkpoint(ckpt_path, keep)
+                else None
+            )
+            continue
+        except CheckpointMismatch:
+            raise  # unsound to recover; the caller picked a wrong file
+        except (CheckpointCorrupt, CheckpointError) as exc:
+            # our own resume checkpoint won't load: start over, fresh
+            _spend(exc, "ckpt-load")
+            run_kw["resume"] = None
+            continue
+        except Exception as exc:
+            if not (isinstance(exc, InjectedCrash) or is_transient(exc)):
+                raise
+            cause = ("crash" if isinstance(exc, InjectedCrash)
+                     else "transient")
+            _spend(exc, cause)
+            if has_checkpoint(ckpt_path, keep):
+                run_kw["resume"] = ckpt_path
+            continue
+        return result

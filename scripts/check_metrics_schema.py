@@ -12,8 +12,13 @@ actions block must be [enabled, fired, new] non-negative int triples
 matching actions_total, coverage must come before the run's summary
 with non-decreasing wave indices, and the cumulative per-action
 counters must be monotone non-decreasing cell by cell across the
-stream. Exit status 0 iff every file is clean — bench.py runs this
-after each telemetry-enabled run.
+stream. The resilience events (retry / resume / ckpt_generation /
+preempt, from the self-healing runtime) are validated too: retry
+attempts must be ints >= 1 strictly increasing across a supervised
+session (a summary resets the counter), backoff_s non-negative,
+resume/ckpt_generation generations ints >= 0, and ckpt_generation
+skipped-diagnostics a list of strings. Exit status 0 iff every file is
+clean — bench.py runs this after each telemetry-enabled run.
 
 Dependency-free on purpose (no jax/numpy import happens): schema
 validation must work on a machine with nothing but the repo checked

@@ -198,6 +198,7 @@ def test_schema_and_renderer_stay_in_sync():
     # the contract check_metrics_schema.py and the engines share
     assert tuple(n for n, _ in DECLARED_EVENTS) == (
         "manifest", "wave", "stall", "coverage", "summary",
+        "retry", "resume", "ckpt_generation", "preempt",
     )
     for _, keys in DECLARED_EVENTS:
         assert keys[0] == "event"

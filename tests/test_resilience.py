@@ -1,0 +1,667 @@
+"""Self-healing runtime tests (resilience/): crash-safe checkpoint
+format, deterministic fault injection, the auto-resume supervisor, and
+the CLI's exit-code contract.
+
+The load-bearing gate is chaos PARITY: a supervised run that suffers an
+injected crash, a torn checkpoint write, and a spurious frontier
+overflow must end with counts bit-identical to a fault-free run —
+exploration is deterministic, so recovery from a wave-start checkpoint
+changes nothing but wall-clock. Host-engine parity runs in tier-1; the
+device and sharded engines (and the real-SIGTERM subprocess drill) are
+slow-marked, mirroring the existing checkpoint tests' tiering.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.models.raft import RaftParams, cached_model
+from raft_tpu.resilience import (
+    CapacityOverflow,
+    ChaosInjector,
+    ChaosSpec,
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    InjectedCrash,
+    InjectedTransient,
+    PreemptionGuard,
+    UnrecoverableError,
+    supervise,
+)
+from raft_tpu.resilience import ckpt as rckpt
+
+RAFT2 = RaftParams(n_servers=2, n_values=2, max_elections=2,
+                   max_restarts=0, msg_slots=16)
+
+
+def _kraft():
+    from raft_tpu.models.kraft import KRaftParams
+    from raft_tpu.models.kraft import cached_model as kraft_cached
+
+    return kraft_cached(KRaftParams(
+        n_servers=3, n_values=1, max_elections=2, max_restarts=0,
+        msg_slots=24,
+    ))
+
+
+def _first_inv(model):
+    return tuple(list(model.invariants)[:1])
+
+
+# ------------------------------------------------------- ckpt format
+
+
+def _payload(depth=3):
+    return dict(
+        version=1,
+        spec="test/spec/1",
+        frontier=np.arange(12, dtype=np.int32).reshape(3, 4),
+        seen=np.array([1, 2, 3], dtype=np.uint64),
+        depth=depth,
+    )
+
+
+def test_ckpt_roundtrip_adds_version_and_hash(tmp_path):
+    path = str(tmp_path / "a" / "b" / "ck.npz")  # parents auto-created
+    rckpt.save_npz(path, _payload())
+    loaded, gen, skipped = rckpt.load_npz(path)
+    assert gen == 0 and skipped == []
+    assert rckpt.format_version_of(loaded) == rckpt.FORMAT_VERSION
+    assert int(loaded["depth"]) == 3
+    np.testing.assert_array_equal(loaded["frontier"], _payload()["frontier"])
+
+
+def test_ckpt_hash_catches_payload_corruption(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    rckpt.save_npz(path, _payload(), keep=1)
+    # flip one byte in the zip payload region; the zip container often
+    # still parses, so only the content hash catches it
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorrupt):
+        rckpt.load_npz(path, keep=1)
+
+
+def test_ckpt_generation_rotation_and_fallback(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    for d in (1, 2, 3):
+        rckpt.save_npz(path, _payload(depth=d), keep=3)
+    assert os.path.exists(path + ".gen1") and os.path.exists(path + ".gen2")
+    # newest first: gen0=3, gen1=2, gen2=1
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 3)
+    loaded, gen, skipped = rckpt.load_npz(path, keep=3)
+    assert gen == 1 and int(loaded["depth"]) == 2
+    assert len(skipped) == 1 and "ck.npz" in skipped[0]
+
+
+def test_ckpt_all_generations_corrupt_lists_every_problem(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    rckpt.save_npz(path, _payload(1), keep=2)
+    rckpt.save_npz(path, _payload(2), keep=2)
+    for p in (path, path + ".gen1"):
+        with open(p, "r+b") as fh:
+            fh.truncate(8)
+    with pytest.raises(CheckpointCorrupt) as ei:
+        rckpt.load_npz(path, keep=2)
+    assert len(ei.value.problems) == 2
+
+
+def test_ckpt_v1_file_loads_unverified(tmp_path):
+    # pre-resilience files have no format_version/content_hash fields
+    path = str(tmp_path / "old.npz")
+    np.savez(path + ".tmp.npz", **_payload())
+    os.replace(path + ".tmp.npz", path)
+    loaded, gen, skipped = rckpt.load_npz(path)
+    assert gen == 0 and skipped == []
+    assert rckpt.format_version_of(loaded) == 1
+
+
+def test_check_spec_mismatch_and_future_version(tmp_path):
+    with pytest.raises(CheckpointMismatch, match="checkpoint is for spec"):
+        rckpt.check_spec({"spec": "a"}, "b", "p.npz")
+    # CheckpointMismatch IS a ValueError: pre-existing engine tests
+    # match the same message with pytest.raises(ValueError)
+    assert issubclass(CheckpointMismatch, ValueError)
+    with pytest.raises(CheckpointMismatch, match="newer than this build"):
+        rckpt.check_spec(
+            {"spec": "a", "format_version": rckpt.FORMAT_VERSION + 1},
+            "a", "p.npz")
+    # a payload with no spec field fails with a sentence, not a KeyError
+    with pytest.raises(CheckpointMismatch, match="missing spec"):
+        rckpt.check_spec({}, "a", "p.npz")
+
+
+def test_validate_resume_paths(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        rckpt.validate_resume(str(tmp_path / "none.npz"), "x")
+    path = str(tmp_path / "ck.npz")
+    rckpt.save_npz(path, _payload(depth=5))
+    assert rckpt.validate_resume(path, "test/spec/1") == (0, 5)
+    with pytest.raises(CheckpointMismatch):
+        rckpt.validate_resume(path, "other/spec")
+
+
+# ------------------------------------------------------- chaos harness
+
+
+def test_chaos_spec_grammar():
+    spec = ChaosSpec.parse("crash=3,truncate=2,seed=7")
+    assert spec.crash == 3 and spec.truncate == 2 and spec.seed == 7
+    assert "crash=3" in str(spec)
+    for bad in ("crash", "crash=zero", "bogus=1", "crash=1,crash=2",
+                "crash=0"):
+        with pytest.raises(ValueError):
+            ChaosSpec.parse(bad)
+
+
+def test_chaos_faults_fire_exactly_once():
+    inj = ChaosInjector(ChaosSpec.parse("crash=2,transient=3,ovf=4"))
+    inj.wave_start(1)
+    with pytest.raises(InjectedCrash):
+        inj.wave_start(2)
+    inj.wave_start(2)  # consumed: a resumed run passes wave 2 freely
+    with pytest.raises(InjectedTransient):
+        inj.wave_start(3)
+    assert inj.ovf_bits(0, 4, frontier_bit=4) == 4
+    assert inj.ovf_bits(0, 4, frontier_bit=4) == 0
+    assert inj.ovf_bits(1, 5, frontier_bit=4) == 1  # real bits untouched
+
+
+def test_chaos_truncates_nth_checkpoint_write(tmp_path):
+    inj = ChaosInjector(ChaosSpec.parse("truncate=2"))
+    path = str(tmp_path / "ck.npz")
+    rckpt.save_npz(path, _payload(1), keep=3, chaos=inj)
+    intact = os.path.getsize(path)
+    rckpt.save_npz(path, _payload(2), keep=3, chaos=inj)  # 2nd write torn
+    assert os.path.getsize(path) < intact
+    loaded, gen, skipped = rckpt.load_npz(path, keep=3)
+    assert gen == 1 and int(loaded["depth"]) == 1 and skipped
+
+
+def test_preempt_guard_and_chaos_sigterm():
+    with PreemptionGuard() as guard:
+        assert not guard.requested
+        inj = ChaosInjector(ChaosSpec.parse("preempt=2"))
+        inj.wave_start(1)
+        assert not guard.requested
+        inj.wave_start(2)  # SIGTERM self-delivery
+        assert guard.requested and guard.signame == "SIGTERM"
+    # handler restored; a fresh guard starts clean
+    assert not PreemptionGuard().requested
+
+
+# ------------------------------------------------------- supervisor
+
+
+class _Result:
+    def __init__(self, exit_cause=None):
+        self.exit_cause = exit_cause
+        self.distinct = 42
+
+
+class _ScriptedEngine:
+    """Raises the scripted exceptions, one run() per entry, then wins."""
+
+    def __init__(self, script, overrides, log):
+        self.script = script
+        self.overrides = overrides
+        self.log = log
+
+    def grow_for_overflow(self, bits):
+        return None if bits & 1 else {"frontier_cap": 2048}
+
+    def run(self, **kw):
+        self.log.append(dict(overrides=self.overrides,
+                             resume=kw.get("resume")))
+        if self.script:
+            raise self.script.pop(0)
+        return _Result()
+
+
+def _scripted_factory(script, log):
+    return lambda overrides: _ScriptedEngine(script, overrides, log)
+
+
+def test_supervise_overflow_grows_and_resumes(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    rckpt.save_npz(ck, _payload())
+    log = []
+    exc = CapacityOverflow("ovf", what=("frontier",), bits=4,
+                           checkpoint_saved=True)
+    res = supervise(_scripted_factory([exc], log),
+                    {"checkpoint_path": ck}, backoff_base=0.0)
+    assert res.distinct == 42
+    assert log[0] == {"overrides": {}, "resume": None}
+    assert log[1] == {"overrides": {"frontier_cap": 2048}, "resume": ck}
+
+
+def test_supervise_overflow_without_checkpoint_restarts_fresh(tmp_path):
+    # the sharded engine cannot save at its abort point; with no
+    # checkpoint on disk the supervisor restarts fresh with grown caps
+    log = []
+    exc = CapacityOverflow("ovf", what=("frontier",), bits=4)
+    res = supervise(
+        _scripted_factory([exc], log),
+        {"checkpoint_path": str(tmp_path / "never-written.npz")},
+        backoff_base=0.0)
+    assert res.distinct == 42
+    assert log[1] == {"overrides": {"frontier_cap": 2048}, "resume": None}
+
+
+def test_supervise_msg_slot_overflow_is_fatal():
+    exc = CapacityOverflow("msg", what=("msg",), bits=1)
+    with pytest.raises(UnrecoverableError, match="no growth policy"):
+        supervise(_scripted_factory([exc], []), {}, backoff_base=0.0)
+
+
+def test_supervise_retry_budget(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    rckpt.save_npz(ck, _payload())
+    script = [InjectedCrash("boom") for _ in range(3)]
+    with pytest.raises(UnrecoverableError, match="retry budget exhausted"):
+        supervise(_scripted_factory(script, []),
+                  {"checkpoint_path": ck},
+                  max_retries=2, backoff_base=0.0)
+
+
+def test_supervise_mismatch_is_fatal():
+    with pytest.raises(CheckpointMismatch):
+        supervise(_scripted_factory([CheckpointMismatch("wrong spec")], []),
+                  {}, backoff_base=0.0)
+
+
+def test_supervise_corrupt_resume_falls_back_to_fresh(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    rckpt.save_npz(ck, _payload())
+    log = []
+    script = [CheckpointCorrupt("torn", problems=("p",))]
+    res = supervise(_scripted_factory(script, log),
+                    {"checkpoint_path": ck, "resume": ck},
+                    backoff_base=0.0)
+    assert res.distinct == 42
+    assert log[0]["resume"] == ck and log[1]["resume"] is None
+
+
+def test_supervise_preempted_result_is_returned():
+    log = []
+    res = supervise(_scripted_factory([], log), {}, backoff_base=0.0)
+    assert res.exit_cause is None
+    engine = _ScriptedEngine([], {}, [])
+    engine.run = lambda **kw: _Result(exit_cause="preempted")
+    res = supervise(lambda o: engine, {}, backoff_base=0.0)
+    assert res.exit_cause == "preempted"
+
+
+def test_supervise_emits_retry_events(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    rckpt.save_npz(ck, _payload())
+
+    class _Tel:
+        events = []
+
+        def event(self, etype, **fields):
+            self.events.append((etype, fields))
+
+    script = [InjectedTransient("flake"), InjectedCrash("boom")]
+    supervise(_scripted_factory(script, []), {"checkpoint_path": ck},
+              backoff_base=0.0, telemetry=_Tel())
+    kinds = [(e, f["attempt"], f["cause"]) for e, f in _Tel.events]
+    assert kinds == [("retry", 1, "transient"), ("retry", 2, "crash")]
+
+
+# ------------------------------------------------------- event schema
+
+
+def test_resilience_events_validate():
+    from raft_tpu.obs.events import validate_event, validate_lines
+
+    good = [
+        {"event": "retry", "attempt": 1, "cause": "crash",
+         "backoff_s": 0.5, "growth": "-"},
+        {"event": "resume", "path": "ck.npz", "generation": 1,
+         "depth": 3, "distinct": 99},
+        {"event": "ckpt_generation", "path": "ck.npz", "generation": 1,
+         "skipped": ["gen0: torn"]},
+        {"event": "preempt", "signame": "SIGTERM", "depth": 3,
+         "checkpoint": "ck.npz"},
+    ]
+    for ev in good:
+        assert validate_event(ev) == [], ev
+    assert validate_event({"event": "retry", "attempt": 0, "cause": "c",
+                           "backoff_s": 0, "growth": "-"})
+    assert validate_event({"event": "ckpt_generation", "path": "p",
+                           "generation": -1, "skipped": []})
+    assert validate_event({"event": "preempt", "signame": "SIGTERM",
+                           "depth": 0})  # missing checkpoint key
+    # retry attempts must be strictly increasing within a session
+    lines = [json.dumps({"event": "retry", "attempt": a, "cause": "c",
+                         "backoff_s": 0.0, "growth": "-"})
+             for a in (1, 1)]
+    _, problems = validate_lines(lines)
+    assert any("attempt" in p for p in problems)
+
+
+# ------------------------------------------------------- host engine
+
+
+def _host_run(model, inv, **kw):
+    kw.setdefault("max_depth", 4)
+    return BFSChecker(model, invariants=inv, symmetry=True,
+                      chunk=256).run(**kw)
+
+
+def _sig(res):
+    return (res.distinct, res.total, res.depth,
+            [int(x) for x in res.depth_counts], res.terminal, res.coverage)
+
+
+def test_host_chaos_parity_crash_truncate_ovf(tmp_path):
+    """The tier-1 chaos smoke: spurious overflow at wave 2, a torn
+    checkpoint write, and a crash at wave 3 — the supervised session
+    must converge to counts bit-identical to the fault-free run, with
+    the generation fallback and retry events on the wire."""
+    from raft_tpu.obs import Telemetry
+    from raft_tpu.obs.events import validate_lines
+
+    model = cached_model(RAFT2)
+    inv = _first_inv(model)
+    ref = _host_run(model, inv)
+
+    ck = str(tmp_path / "ck.npz")
+    mpath = str(tmp_path / "m.jsonl")
+    tel = Telemetry(metrics_path=mpath)
+    chaos = ChaosInjector(ChaosSpec.parse("ovf=2,crash=3,truncate=2"))
+    res = supervise(
+        lambda ov: BFSChecker(model, invariants=inv, symmetry=True,
+                              chunk=256),
+        dict(max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0,
+             chaos=chaos, telemetry=tel),
+        backoff_base=0.0, telemetry=tel,
+    )
+    tel.close()
+    assert _sig(res) == _sig(ref)
+    assert sorted(chaos.fired) == ["crash", "ovf", "truncate"]
+    with open(mpath) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+    assert counts["retry"] == 2 and counts["resume"] == 2
+    # the torn generation was skipped on one of the resumes
+    assert counts.get("ckpt_generation", 0) >= 1
+
+
+def test_host_v1_backcompat_resume_zeroes_coverage(tmp_path):
+    model = cached_model(RAFT2)
+    inv = _first_inv(model)
+    ref = _host_run(model, inv)
+    ck = str(tmp_path / "ck.npz")
+    _host_run(model, inv, checkpoint_path=ck, checkpoint_every_s=0.0,
+              max_depth=2)
+    # rewrite as a version-1-era file: no format_version, no content
+    # hash, no coverage field (pre-coverage builds)
+    with np.load(ck, allow_pickle=False) as z:
+        fields = {k: z[k] for k in z.files
+                  if k not in ("format_version", "content_hash", "coverage")}
+    np.savez(ck, **fields)
+    res = _host_run(model, inv, resume=ck)
+    assert _sig(res)[:5] == _sig(ref)[:5]
+    # coverage resumes zeroed: only waves 3..4 are counted
+    assert res.coverage is not None
+    assert sum(r[2] for r in res.coverage) == ref.distinct - sum(
+        ref.depth_counts[:3])
+
+
+def test_cross_engine_resume_is_a_clear_mismatch(tmp_path):
+    """A host checkpoint fed to the device engine must fail on the spec
+    identity line — never a numpy KeyError from a missing field."""
+    from raft_tpu.checker.device_bfs import DeviceBFS
+
+    model = cached_model(RAFT2)
+    inv = _first_inv(model)
+    ck = str(tmp_path / "ck.npz")
+    _host_run(model, inv, checkpoint_path=ck, checkpoint_every_s=0.0,
+              max_depth=2)
+    with pytest.raises(ValueError, match="checkpoint is for spec") as ei:
+        DeviceBFS(model, invariants=inv).run(resume=ck)
+    assert "host/" in str(ei.value) and isinstance(
+        ei.value, CheckpointMismatch)
+
+
+# ------------------------------------------------------- CLI contract
+
+
+CFG = """\
+CONSTANTS
+    n1 = n1
+    n2 = n2
+    v1 = v1
+    Server = { n1, n2 }
+    Value = { v1 }
+    Follower = Follower
+    Candidate = Candidate
+    Leader = Leader
+    Nil = Nil
+    RequestVoteRequest = RequestVoteRequest
+    RequestVoteResponse = RequestVoteResponse
+    AppendEntriesRequest = AppendEntriesRequest
+    AppendEntriesResponse = AppendEntriesResponse
+    EqualTerm = EqualTerm
+    LessOrEqualTerm = LessOrEqualTerm
+    MaxElections = 1
+    MaxRestarts = 0
+
+INIT Init
+NEXT Next
+
+INVARIANT
+NoLogDivergence
+"""
+
+CLI_BASE = [
+    "--platform", "cpu", "--checker", "tpu-host", "--msg-slots", "16",
+    "--max-depth", "4", "--chunk", "256",
+]
+
+
+def _cfg(tmp_path):
+    cfg = tmp_path / "Raft.cfg"
+    cfg.write_text(CFG)
+    return str(cfg)
+
+
+def test_cli_documents_exit_codes():
+    import raft_tpu.__main__ as cli
+
+    doc = cli.__doc__
+    for needle in ("2 ", "3 ", "4 ", "5 ", "64", "66", "preempted",
+                   "unrecoverable"):
+        assert needle in doc
+
+
+def test_cli_chaos_preempt_rc4_and_resume(tmp_path, capsys):
+    from raft_tpu.__main__ import main
+
+    cfg = _cfg(tmp_path)
+    ck = str(tmp_path / "runs" / "ck.npz")  # exercises --checkpoint makedirs
+    rc = main([cfg, *CLI_BASE, "--checkpoint", ck, "--checkpoint-every",
+               "0", "--chaos", "preempt=2"])
+    cap = capsys.readouterr()
+    assert rc == 4, cap.err
+    assert "preempted (SIGTERM)" in cap.out and os.path.exists(ck)
+    # the preemption checkpoint is hash-verified and resumable
+    loaded, gen, skipped = rckpt.load_npz(ck)
+    assert gen == 0 and not skipped
+    assert rckpt.format_version_of(loaded) == rckpt.FORMAT_VERSION
+    rc = main([cfg, *CLI_BASE, "--resume", ck])
+    cap = capsys.readouterr()
+    assert rc == 0, cap.err
+    assert "resume: validated" in cap.err
+
+
+def test_cli_supervised_chaos_smoke_matches_fault_free(tmp_path, capsys):
+    """Fast chaos smoke: crash at wave 2, auto-resume, result line
+    identical to the fault-free run."""
+    from raft_tpu.__main__ import main
+
+    cfg = _cfg(tmp_path)
+    rc = main([cfg, *CLI_BASE])
+    ref_line = next(ln for ln in capsys.readouterr().out.splitlines()
+                    if ln.startswith("distinct="))
+    ck = str(tmp_path / "ck.npz")
+    rc = main([cfg, *CLI_BASE, "--checkpoint", ck, "--checkpoint-every",
+               "0", "--chaos", "crash=2", "--supervise"])
+    cap = capsys.readouterr()
+    assert rc == 0, cap.err
+    line = next(ln for ln in cap.out.splitlines()
+                if ln.startswith("distinct="))
+    # wall-clock differs; the counts must not
+    assert line.split(" time=")[0] == ref_line.split(" time=")[0]
+
+
+def test_cli_resume_failfast_exit_codes(tmp_path, capsys):
+    from raft_tpu.__main__ import main
+
+    cfg = _cfg(tmp_path)
+    # missing file -> 66, before any engine work
+    rc = main([cfg, *CLI_BASE, "--resume", str(tmp_path / "none.npz")])
+    assert rc == 66
+    ck = str(tmp_path / "ck.npz")
+    rc = main([cfg, *CLI_BASE, "--checkpoint", ck, "--checkpoint-every",
+               "0"])
+    assert rc == 0
+    # wrong identity (different msg-slots geometry) -> 64 with the spec
+    # sentence on stderr
+    rc = main([cfg, *CLI_BASE[:-4], "--msg-slots", "24", "--max-depth",
+               "4", "--chunk", "256", "--resume", ck])
+    cap = capsys.readouterr()
+    assert rc == 64 and "checkpoint is for spec" in cap.err
+    # every generation torn -> 5 (unrecoverable), problems listed;
+    # tearing ONLY the newest would fall back (and exit 0), so tear all
+    for g in range(3):
+        gp = rckpt.generation_path(ck, g)
+        if os.path.exists(gp):
+            with open(gp, "r+b") as fh:
+                fh.truncate(10)
+    rc = main([cfg, *CLI_BASE, "--resume", ck])
+    cap = capsys.readouterr()
+    assert rc == 5 and "unreadable" in cap.err
+    # bad chaos grammar -> 64
+    rc = main([cfg, *CLI_BASE, "--chaos", "nope=1"])
+    assert rc == 64
+
+
+# ----------------------------------------------- device/sharded (slow)
+
+
+def _engine_factory(kind, model, inv):
+    if kind == "device":
+        from raft_tpu.checker.device_bfs import DeviceBFS
+
+        return lambda ov: DeviceBFS(
+            model, invariants=inv, symmetry=True,
+            **{**dict(chunk=512, frontier_cap=1 << 14, seen_cap=1 << 17,
+                      journal_cap=1 << 17), **ov})
+    import jax
+
+    from raft_tpu.parallel.sharded import ShardedBFS
+
+    return lambda ov: ShardedBFS(
+        model, invariants=inv, symmetry=True, devices=jax.devices()[:4],
+        **{**dict(chunk=128, frontier_cap=1024, seen_cap=4096), **ov})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["device", "sharded"])
+@pytest.mark.parametrize("family", ["raft", "kraft"])
+def test_engine_chaos_parity(kind, family, tmp_path):
+    """The chaos parity gate on the accelerator engines: injected
+    frontier overflow (wave 2, triggers regrow-and-resume), a torn
+    checkpoint write, and a crash at wave 3 — supervised recovery must
+    be bit-identical to the fault-free run on both model families."""
+    model = cached_model(RAFT2) if family == "raft" else _kraft()
+    inv = _first_inv(model)
+    factory = _engine_factory(kind, model, inv)
+    ref = factory({}).run(max_depth=4)
+
+    ck = str(tmp_path / "ck.npz")
+    chaos = ChaosInjector(ChaosSpec.parse("ovf=2,crash=3,truncate=2"))
+    res = supervise(
+        factory,
+        dict(max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0,
+             chaos=chaos),
+        backoff_base=0.0,
+    )
+    assert sorted(chaos.fired) == ["crash", "ovf", "truncate"]
+    assert res.distinct == ref.distinct
+    assert [int(x) for x in res.depth_counts] == [
+        int(x) for x in ref.depth_counts]
+    assert res.total == ref.total and res.terminal == ref.terminal
+    assert res.coverage == ref.coverage
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["device", "sharded"])
+def test_engine_v1_backcompat(kind, tmp_path):
+    """A version-1-era checkpoint (no hash, no coverage field) still
+    resumes on the accelerator engines, with coverage zeroed."""
+    model = cached_model(RAFT2)
+    inv = _first_inv(model)
+    factory = _engine_factory(kind, model, inv)
+    ref = factory({}).run(max_depth=4)
+    ck = str(tmp_path / "ck.npz")
+    factory({}).run(max_depth=2, checkpoint_path=ck, checkpoint_every_s=0.0)
+    with np.load(ck, allow_pickle=False) as z:
+        fields = {k: z[k] for k in z.files
+                  if k not in ("format_version", "content_hash", "coverage")}
+    np.savez(ck, **fields)
+    res = factory({}).run(resume=ck, max_depth=4)
+    assert res.distinct == ref.distinct
+    assert [int(x) for x in res.depth_counts] == [
+        int(x) for x in ref.depth_counts]
+    assert sum(r[2] for r in res.coverage) == ref.distinct - sum(
+        ref.depth_counts[:3])
+
+
+@pytest.mark.slow
+def test_cli_sigterm_device_rc4_checkpoint_resume(tmp_path):
+    """The preemptible-TPU drill, end to end: kill -TERM a DeviceBFS
+    run mid-flight -> rc 4 with an intact, hash-verified checkpoint ->
+    --resume completes cleanly."""
+    cfg = _cfg(tmp_path)
+    ck = str(tmp_path / "ck.npz")
+    base = [sys.executable, "-m", "raft_tpu", cfg, "--platform", "cpu",
+            "--checker", "tpu", "--msg-slots", "16", "--max-depth", "6",
+            "--chunk", "256", "--checkpoint", ck, "--checkpoint-every", "0"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(base, stderr=subprocess.PIPE, text=True,
+                            cwd=os.path.dirname(os.path.dirname(__file__)))
+    # wait for the banner (guard installs right after engine build),
+    # then one SIGTERM — the run is mid-compile or mid-wave either way
+    for line in proc.stderr:
+        if line.startswith("spec="):
+            break
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=300)
+    proc.stderr.close()
+    assert rc == 4
+    loaded, gen, skipped = rckpt.load_npz(ck)
+    assert not skipped
+    assert rckpt.format_version_of(loaded) == rckpt.FORMAT_VERSION
+    out = subprocess.run(
+        base[:-4] + ["--resume", ck], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr
+    assert "no invariant violations" in out.stdout
