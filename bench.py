@@ -144,6 +144,95 @@ def repro_main():
     return 0 if out["within_10pct"] and out["counts_match"] else 1
 
 
+SWEEP_MANIFEST = {
+    "spec": "Raft",
+    "defaults": {
+        "constants": {"Server": ["s1", "s2", "s3"], "Value": ["v1"],
+                      "MaxElections": 1, "MaxRestarts": 1},
+        "invariants": ["NoLogDivergence"],
+        "msg_slots": 24,
+    },
+    # 16 configs, one packed layout: MaxElections 1 and 2 share the
+    # 2-bit term width, MaxRestarts never shapes the program
+    "grid": {"MaxRestarts": [1, 2, 3, 4, 5, 6, 7, 8],
+             "MaxElections": [1, 2]},
+}
+
+
+def sweep_main():
+    """--sweep: fleet amortization benchmark (host engine, CPU-friendly).
+
+    Runs the 16-config Raft sweep twice — once as 16 serial runs (one
+    fresh model per job, the cost a user pays without the fleet driver)
+    and once through `run_sweep` as ONE packed group — asserts per-job
+    bit-identical distinct/total/depth/violation, and prints one JSON
+    line whose detail carries the fleet amortization stats (precompile
+    count vs job count) as provenance."""
+    depth = int(os.environ.get("BENCH_SWEEP_DEPTH", "6"))
+
+    import jax
+
+    from raft_tpu.checker.bfs import BFSChecker
+    from raft_tpu.fleet import SweepOptions, parse_manifest_obj, run_sweep
+    from raft_tpu.fleet.grouping import build_setup, group_jobs
+
+    mf = parse_manifest_obj(SWEEP_MANIFEST, path="bench.py --sweep")
+
+    # serial leg: a fresh model per job = a fresh jit cache per job
+    serial = {}
+    t0 = time.perf_counter()
+    for job in mf.jobs:
+        setup = build_setup(job, mf.path)
+        res = BFSChecker(
+            setup.model, invariants=setup.invariants,
+            symmetry=setup.symmetry,
+        ).run(max_depth=depth)
+        serial[job.name] = {
+            "distinct": res.distinct, "total": res.total,
+            "depth": res.depth,
+            "violation": res.violation.invariant if res.violation else None,
+        }
+    serial_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    fleet = run_sweep(mf, SweepOptions(engine="host", max_depth=depth))
+    fleet_s = time.perf_counter() - t0
+
+    mismatches = []
+    for j in fleet.jobs:
+        s = serial[j.name]
+        f = {
+            "distinct": j.distinct, "total": j.total, "depth": j.depth,
+            "violation": j.violation["invariant"] if j.violation else None,
+        }
+        if f != s:
+            mismatches.append({"job": j.name, "serial": s, "fleet": f})
+    groups = group_jobs(mf)
+    am = fleet.amortization
+    ok = (not mismatches
+          and am["precompiles"] <= am["groups"]
+          and fleet_s < serial_s)
+    out = {
+        "metric": "fleet_sweep_speedup_vs_serial",
+        "value": round(serial_s / fleet_s, 2) if fleet_s > 0 else None,
+        "unit": "x (16-config Raft sweep, host engine)",
+        "platform": jax.devices()[0].platform,
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "detail": {
+            "jobs": len(mf.jobs),
+            "max_depth": depth,
+            "serial_s": round(serial_s, 2),
+            "fleet_s": round(fleet_s, 2),
+            "amortization": am,
+            "group_kinds": [g.kind for g in groups],
+            "counts_bit_identical": not mismatches,
+            "mismatches": mismatches[:4],
+        },
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
 def measure_floor(reps: int = 5) -> float:
     """Median wall seconds of a null dispatch + device_get sync — the
     tunnel floor every wave pays once. block_until_ready does not
@@ -348,4 +437,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--sweep" in sys.argv[1:]:
+        sys.exit(sweep_main())
     sys.exit(repro_main() if "--repro" in sys.argv[1:] else main())

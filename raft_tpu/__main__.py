@@ -29,6 +29,12 @@ import sys
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "sweep":
+        # fleet subcommand: `raft_tpu sweep MANIFEST.json` (fleet/cli.py)
+        from .fleet.cli import sweep_main
+
+        return sweep_main(argv[1:])
     ap = argparse.ArgumentParser(prog="raft_tpu")
     ap.add_argument("cfg", help="TLC .cfg file (the spec is inferred from its name)")
     ap.add_argument("--spec", help="spec/module name override")
@@ -138,6 +144,13 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--msg-slots", type=int, default=None,
                     help="message-bag slot count (default: per-spec)")
+    ap.add_argument(
+        "--net-faults",
+        action="store_true",
+        help="enable the opt-in DuplicateMessage/DropMessage network-"
+        "fault actions (Raft.tla:508-523; Raft family only; duplication "
+        "bounded to max_msg_copies per record)",
+    )
     ap.add_argument("--no-symmetry", action="store_true", help="ignore SYMMETRY")
     ap.add_argument(
         "--trace-format",
@@ -240,7 +253,10 @@ def main(argv=None):
         cfg = parse_cfg(args.cfg, lenient=args.lenient)
         for diag in cfg.diagnostics:
             print(f"config warning: {diag}", file=sys.stderr)
-        setup = build_from_cfg(cfg, spec=args.spec, msg_slots=args.msg_slots)
+        setup = build_from_cfg(
+            cfg, spec=args.spec, msg_slots=args.msg_slots,
+            net_faults=args.net_faults,
+        )
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 66

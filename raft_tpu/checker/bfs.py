@@ -517,6 +517,412 @@ class BFSChecker:
             exit_cause=exit_cause,
         )
 
+    # ---------------- fleet (packed co-resident jobs) ----------------
+
+    def run_fleet(
+        self,
+        job_names: list[str] | None = None,
+        max_depth: int | None = None,
+        verbose: bool = False,
+        time_budget_s: float | None = None,
+        telemetry=None,
+    ) -> list[CheckResult]:
+        """Run every job of a fleet-bound model (models/base.py
+        FleetConstMixin) through ONE shared BFS: all jobs' stamped init
+        states live in one frontier / seen-set / journal, and the job
+        lane keeps their fingerprints disjoint.
+
+        Per-job tallies (distinct/total/terminal/coverage/depth_counts)
+        are split out of the shared wave with bincounts on the job lane;
+        a job that violates an invariant has its rows masked from the
+        next frontier, so finished jobs idle at zero cost while the
+        rest keep exploring. Because the frontier stays job-major and
+        first-occurrence dedup is fingerprint-value-independent, every
+        job's emitted state sequence — and therefore its distinct
+        count, depth histogram and counterexample trace — is
+        bit-identical to a serial ``run()`` of that job (pinned by
+        tests/test_fleet.py). ``seconds`` on each result is the GROUP
+        wall time: co-resident jobs do not have separable clocks.
+
+        ``max_depth``/``time_budget_s`` are fleet-global (a per-job
+        depth limit would desynchronize the shared wave). Checkpointing
+        is not multiplexed on this arm — the driver re-runs a packed
+        group on resume (fleet/driver.py); the queue arm has per-job
+        lineages.
+        """
+        model = self.model
+        B = self.chunk
+        J = model.fleet_jobs
+        if J == 0:
+            raise ValueError("run_fleet needs a fleet-bound model (fleet_bind)")
+        names = list(job_names) if job_names else [f"job{j}" for j in range(J)]
+        if len(names) != J:
+            raise ValueError(f"{len(names)} job names for {J} jobs")
+        tel = telemetry if telemetry is not None else NULL_TELEMETRY
+        t0 = time.perf_counter()
+        K = self.n_actions
+
+        model.fleet_select(None)
+        init = model.init_states()
+        init_jobs = model.fleet_job_of(init).astype(np.int64)
+        n0_by_job = np.bincount(init_jobs, minlength=J).astype(np.int64)
+        init_fps = np.asarray(jax.device_get(self._fps(init)), dtype=np.uint64)
+        order = np.argsort(init_fps, kind="stable")
+        keep = np.ones(len(order), dtype=bool)
+        sorted_fps = init_fps[order]
+        dup = np.zeros(len(order), dtype=bool)
+        dup[1:] = sorted_fps[1:] == sorted_fps[:-1]
+        keep[order[dup]] = False
+        frontier = init[keep]
+        fjobs = init_jobs[keep]
+        fgids = np.arange(len(frontier), dtype=np.int64)
+        self._init_distinct = frontier
+        self._parents, self._cands = [], []  # fleet-global gid journal
+        seen = np.sort(init_fps[keep])
+
+        total_j = n0_by_job.copy()
+        distinct_j = np.bincount(fjobs, minlength=J).astype(np.int64)
+        depth_counts_j = [[int(x)] for x in distinct_j]
+        terminal_j = np.zeros(J, np.int64)
+        depth_j = np.zeros(J, np.int64)
+        violation_j: list[Violation | None] = [None] * J
+        cov_j = np.zeros((J, K, 3), dtype=np.int64)
+        active = np.ones(J, dtype=bool)
+        depth = 0
+        next_gid = len(frontier)
+        exit_cause_global = None
+
+        tel.open_run({**self._telemetry_manifest(), "fleet_jobs": J})
+
+        self._fleet_check_invariants(
+            frontier, fgids, fjobs, 0, violation_j, active
+        )
+        if not active.all():
+            m = active[fjobs]
+            frontier, fjobs, fgids = frontier[m], fjobs[m], fgids[m]
+
+        while len(frontier):
+            if max_depth is not None and depth >= max_depth:
+                exit_cause_global = "max_depth"
+                break
+            if (
+                time_budget_s is not None
+                and time.perf_counter() - t0 > time_budget_s
+            ):
+                exit_cause_global = "time_budget"
+                break
+            tw = time.perf_counter()
+            wave_sb = _AppendBuf(model.layout.W, np.int32)
+            wave_pb = _AppendBuf(None, np.int64)
+            wave_cb = _AppendBuf(None, np.int32)
+            wave_jb = _AppendBuf(None, np.int64)
+            wave_fps = np.empty(0, dtype=np.uint64)
+            cand_by_job = np.zeros(J, np.int64)
+            has_succ = np.zeros(len(frontier), dtype=bool)
+            with tel.wave_annotation(depth + 1):
+                for off in range(0, len(frontier), B):
+                    chunk_states = frontier[off : off + B]
+                    nb = len(chunk_states)
+                    jrows = fjobs[off : off + nb]
+                    if nb < B:
+                        pad = np.repeat(chunk_states[-1:], B - nb, axis=0)
+                        chunk_states = np.concatenate(
+                            [chunk_states, pad], axis=0
+                        )
+                        jrows_p = np.concatenate(
+                            [jrows, np.repeat(jrows[-1:], B - nb)]
+                        )
+                    else:
+                        jrows_p = jrows
+                    if self._sparse:
+                        valid, rank, ovf = (
+                            np.array(x)
+                            for x in jax.device_get(
+                                self._guards(chunk_states)
+                            )
+                        )
+                    else:
+                        succs, valid, rank, ovf = self._expand(chunk_states)
+                        valid, rank, ovf = (
+                            np.array(x)
+                            for x in jax.device_get((valid, rank, ovf))
+                        )
+                    valid[nb:] = False
+                    if np.any(valid & ovf):
+                        raise CapacityOverflow(
+                            "message-slot overflow: re-run with a larger msg_slots",
+                            what=("msg",), bits=1,
+                        )
+                    jobs_flat = np.repeat(jrows_p, model.A)
+                    if K:
+                        # per-job composite bincount: job * (K+1) + rank,
+                        # with invalid lanes in each job's drop bucket
+                        rk = np.where(valid, rank, K)
+                        flat_rk = rk.reshape(-1)
+                        cnts = np.bincount(
+                            jobs_flat * (K + 1) + flat_rk,
+                            minlength=J * (K + 1),
+                        ).reshape(J, K + 1)
+                        cov_j[:, :, 1] += cnts[:, :K]
+                        hit = np.zeros((len(valid), K + 1), dtype=bool)
+                        hit[np.arange(len(valid))[:, None], rk] = True
+                        np.add.at(cov_j[:, :, 0], jrows_p, hit[:, :K])
+                    if self._sparse:
+                        en_idx = np.nonzero(valid.reshape(-1))[0]
+                        rows, _extra = model.host_apply(
+                            np.asarray(chunk_states), en_idx
+                        )
+                        fps = np.full(
+                            B * model.A, U64_MAX, dtype=np.uint64
+                        )
+                        if len(en_idx):
+                            fps[en_idx] = self._fps_rows(rows)
+                    else:
+                        flat = succs.reshape(-1, model.layout.W)
+                        fps = np.array(
+                            jax.device_get(self._fps(flat)),
+                            dtype=np.uint64,
+                        )
+                        fps[~valid.reshape(-1)] = U64_MAX
+                    cand_by_job += np.bincount(
+                        jrows, weights=valid[:nb].sum(axis=1),
+                        minlength=J,
+                    ).astype(np.int64)
+                    has_succ[off : off + nb] = valid[:nb].any(axis=1)
+
+                    new_mask = fps != U64_MAX
+                    new_mask &= ~_in_sorted(seen, fps)
+                    new_mask &= ~_in_sorted(wave_fps, fps)
+                    _, first_idx = np.unique(fps, return_index=True)
+                    first = np.zeros(len(fps), dtype=bool)
+                    first[first_idx] = True
+                    new_mask &= first
+                    idx = np.nonzero(new_mask)[0]
+                    if K and len(idx):
+                        cov_j[:, :, 2] += np.bincount(
+                            jobs_flat[idx] * (K + 1) + flat_rk[idx],
+                            minlength=J * (K + 1),
+                        ).reshape(J, K + 1)[:, :K]
+                    if len(idx):
+                        if self._sparse:
+                            sel = rows[np.searchsorted(en_idx, idx)]
+                        else:
+                            sel = np.asarray(jax.device_get(flat[idx]))
+                        wave_sb.append(sel)
+                        # parents carry explicit fleet-global gids: the
+                        # serial engine's base_gid+offset arithmetic
+                        # assumes a contiguous frontier, which per-job
+                        # masking breaks
+                        wave_pb.append(fgids[off + idx // model.A])
+                        wave_cb.append((idx % model.A).astype(np.int32))
+                        wave_jb.append(jobs_flat[idx])
+                        wave_fps = np.sort(
+                            np.concatenate([wave_fps, fps[idx]])
+                        )
+
+            total_j += cand_by_job
+            terminal_j += np.bincount(fjobs[~has_succ], minlength=J)
+            if wave_sb.n == 0:
+                break
+            wave_states = wave_sb.take()
+            wave_parents = wave_pb.take()
+            wave_cands = wave_cb.take()
+            wave_jobs = wave_jb.take()
+            self._parents.append(wave_parents)
+            self._cands.append(wave_cands)
+            with tel.annotate("seen_merge"):
+                seen = _merge_sorted(seen, wave_fps)
+            depth += 1
+            new_by_job = np.bincount(wave_jobs, minlength=J)
+            for j in range(J):
+                if new_by_job[j]:
+                    depth_j[j] = depth
+                    depth_counts_j[j].append(int(new_by_job[j]))
+            distinct_j += new_by_job
+            wave_gids = next_gid + np.arange(len(wave_states), dtype=np.int64)
+            next_gid += len(wave_states)
+            self._fleet_check_invariants(
+                wave_states, wave_gids, wave_jobs, depth, violation_j, active
+            )
+            prev_frontier = len(frontier)
+            frontier, fjobs, fgids = wave_states, wave_jobs, wave_gids
+            if not active.all():
+                m = active[fjobs]
+                frontier, fjobs, fgids = frontier[m], fjobs[m], fgids[m]
+            if tel.active or verbose:
+                el = time.perf_counter() - t0
+                distinct = int(distinct_j.sum())
+                total = int(total_j.sum())
+                n_cand_total = int(cand_by_job.sum())
+                tel.wave({
+                    "depth": depth,
+                    "frontier": prev_frontier,
+                    "new": len(wave_states),
+                    "distinct": distinct,
+                    "generated": n_cand_total,
+                    "generated_total": total,
+                    "terminal": int(terminal_j.sum()),
+                    "dedup_hit_rate": round(
+                        1.0 - len(wave_states) / max(1, n_cand_total), 4),
+                    "canon_memo_hits": 0,
+                    "canon_memo_hit_rate": 0.0,
+                    "overflow_bits": 0,
+                    "lsm_runs": 1,
+                    "lsm_lanes": int(len(seen)),
+                    "emit_rows": len(wave_states),
+                    "emit_bytes": wave_sb.nbytes + wave_pb.nbytes
+                    + wave_cb.nbytes,
+                    "frontier_fill": 0.0,
+                    "enabled_density": round(
+                        n_cand_total / max(1, prev_frontier * model.A), 4
+                    ),
+                    "expand_budget_ovf": 0,
+                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "elapsed_s": round(el, 3),
+                    "distinct_per_s": round(distinct / el, 1),
+                    "jobs_active": int(active.sum()),
+                })
+                if verbose:
+                    print(
+                        f"fleet depth {depth}: frontier {len(frontier)}, "
+                        f"distinct {distinct}, {int(active.sum())}/{J} "
+                        f"jobs active",
+                        file=sys.stderr,
+                    )
+
+        dt = time.perf_counter() - t0
+        frontier_jobs = set(int(j) for j in fjobs) if len(frontier) else set()
+        results: list[CheckResult] = []
+        for j in range(J):
+            viol = violation_j[j]
+            if viol is not None:
+                cause = "violation"
+            elif exit_cause_global is not None and j in frontier_jobs:
+                cause = exit_cause_global
+            else:
+                cause = "exhausted"
+            exhausted_j = cause == "exhausted"
+            results.append(CheckResult(
+                distinct=int(distinct_j[j]),
+                total=int(total_j[j]),
+                depth=int(depth_j[j]),
+                depth_counts=depth_counts_j[j],
+                violation=viol,
+                terminal=int(terminal_j[j]),
+                seconds=dt,  # group wall time: jobs are co-resident
+                states_per_sec=int(distinct_j[j]) / dt if dt > 0 else 0.0,
+                exhausted=exhausted_j,
+                trace=self.reconstruct_trace(viol) if viol else None,
+                metrics=None,
+                coverage=[[int(x) for x in row] for row in cov_j[j]]
+                if K else None,
+                exit_cause=cause,
+            ))
+
+        if tel.active:
+            tel.coverage(
+                self._coverage_fields(
+                    depth, cov_j.sum(axis=0), len(seen),
+                    [int(x) for x in np.sum(
+                        [np.pad(np.asarray(dc), (0, depth + 1 - len(dc)))
+                         for dc in depth_counts_j], axis=0)],
+                ),
+                final=True,
+            )
+        first_viol = next((v for v in violation_j if v is not None), None)
+        tel.close_run({
+            "engine": "host",
+            "ident": self._ckpt_ident(),
+            "exit_cause": "violation" if first_viol is not None
+            else (exit_cause_global or "exhausted"),
+            "violation": first_viol.invariant if first_viol else None,
+            "distinct": int(distinct_j.sum()),
+            "total": int(total_j.sum()),
+            "depth": depth,
+            "terminal": int(terminal_j.sum()),
+            "seconds": round(dt, 3),
+            "distinct_per_s": round(int(distinct_j.sum()) / dt, 1)
+            if dt > 0 else 0.0,
+            "exhausted": all(r.exhausted for r in results),
+            "peak_frontier_cap": int(max(
+                max(dc) for dc in depth_counts_j)),
+            "peak_journal_cap": int(next_gid - len(self._init_distinct)),
+            "seen_lanes": int(len(seen)),
+            "canon_memo_hit_rate": 0.0,
+            "fleet_jobs": J,
+        })
+        # per-job synthesized runs: one manifest/coverage/summary triple
+        # per job so obs_report and the schema checker see per-job
+        # digests in the one multiplexed stream
+        if tel.active:
+            man = self._telemetry_manifest()
+            for j, (name, r) in enumerate(zip(names, results)):
+                tel.open_run({**man, "job": name})
+                tel.coverage(
+                    {
+                        **self._coverage_fields(
+                            r.depth, cov_j[j], len(seen), r.depth_counts
+                        ),
+                        "job": name,
+                    },
+                    final=True,
+                )
+                tel.close_run({
+                    "engine": "host",
+                    "ident": self._ckpt_ident(),
+                    "exit_cause": r.exit_cause,
+                    "violation": r.violation.invariant
+                    if r.violation else None,
+                    "distinct": r.distinct,
+                    "total": r.total,
+                    "depth": r.depth,
+                    "terminal": r.terminal,
+                    "seconds": round(dt, 3),
+                    "distinct_per_s": round(r.distinct / dt, 1)
+                    if dt > 0 else 0.0,
+                    "exhausted": r.exhausted,
+                    "peak_frontier_cap": int(max(r.depth_counts)),
+                    "peak_journal_cap": int(
+                        next_gid - len(self._init_distinct)),
+                    "seen_lanes": int(len(seen)),
+                    "canon_memo_hit_rate": 0.0,
+                    "job": name,
+                })
+        return results
+
+    def _fleet_check_invariants(
+        self, states, gids, jobs, depth, violation_j, active
+    ) -> None:
+        """Per-job first violation of a shared wave: for each still-
+        active job, the first invariant (in declaration order) with a
+        bad row, and within it the first row in exploration order —
+        exactly serial ``_check_invariants`` restricted to the job's
+        rows. Deactivates violated jobs in place."""
+        n = len(states)
+        if n == 0:
+            return
+        m = 1 << (n - 1).bit_length()
+        padded = states
+        if m > n:
+            padded = np.concatenate(
+                [states, np.repeat(states[:1], m - n, axis=0)], axis=0
+            )
+        for name in self.invariants:
+            ok = np.asarray(
+                jax.device_get(self.model.invariants[name](padded))
+            )[:n]
+            bad = ~ok
+            if not bad.any():
+                continue
+            for j in np.unique(jobs[bad]):
+                j = int(j)
+                if violation_j[j] is None and active[j]:
+                    r = int(np.nonzero(bad & (jobs == j))[0][0])
+                    violation_j[j] = Violation(
+                        invariant=name, global_id=int(gids[r]), depth=depth
+                    )
+                    active[j] = False
+
     def _fps_rows(self, rows: np.ndarray) -> np.ndarray:
         """Canonical fingerprints of a compact [n, W] row block, padded
         to the next power of two so the jitted canon sees a log-bounded

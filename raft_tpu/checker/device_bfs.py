@@ -1121,6 +1121,64 @@ class DeviceBFS:
         )
         return res
 
+    def run_fleet(
+        self,
+        job_names: list[str] | None = None,
+        telemetry=None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every_s: float = 300.0,
+        checkpoint_keep: int = rckpt.DEFAULT_KEEP,
+        resume: bool = False,
+        skip: tuple[str, ...] = (),
+        **run_kw,
+    ) -> list:
+        """Fleet queue arm: run a fleet-bound model's jobs one at a time
+        through THIS engine instance. ``fleet_select(j)`` changes only
+        which job's constants get stamped into the init states — the
+        compiled programs are shared, so every job after the first is a
+        jit-cache hit (one precompile per layout group). Telemetry is
+        job-tagged into one multiplexed stream (obs.JobTaggedTelemetry);
+        each job checkpoints to its OWN lineage file under
+        ``checkpoint_dir`` (resilience/ckpt.py generations), so the
+        supervisor restarts / resumes only the failed job. Jobs named in
+        ``skip`` (fleet-level resume) yield None in the result list."""
+        import os
+        import re as _re
+
+        from ..obs.collector import JobTaggedTelemetry
+
+        model = self.model
+        J = model.fleet_jobs
+        if J == 0:
+            raise ValueError(
+                "run_fleet needs a fleet-bound model (fleet_bind)"
+            )
+        names = list(job_names) if job_names else [f"job{j}" for j in range(J)]
+        if len(names) != J:
+            raise ValueError(f"{len(names)} job names for {J} jobs")
+        results = []
+        try:
+            for j, name in enumerate(names):
+                if name in skip:
+                    results.append(None)
+                    continue
+                model.fleet_select(j)
+                kw = dict(run_kw)
+                if telemetry is not None:
+                    kw["telemetry"] = JobTaggedTelemetry(telemetry, name)
+                if checkpoint_dir is not None:
+                    safe = _re.sub(r"[^A-Za-z0-9._=-]", "_", name)
+                    ck = os.path.join(checkpoint_dir, f"{safe}.ckpt.npz")
+                    kw.setdefault("checkpoint_path", ck)
+                    kw.setdefault("checkpoint_every_s", checkpoint_every_s)
+                    kw.setdefault("checkpoint_keep", checkpoint_keep)
+                    if resume and os.path.exists(ck):
+                        kw.setdefault("resume", ck)
+                results.append(self.run(**kw))
+        finally:
+            model.fleet_select(None)
+        return results
+
     def _coverage_fields(self, depth, cov_h, scount, depth_counts) -> dict:
         """Dedup-structure gauges + the per-action block for a coverage
         event, all from values the wave loop already holds on host."""

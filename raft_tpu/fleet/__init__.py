@@ -1,0 +1,45 @@
+"""raft_tpu.fleet — sweep driver: many configs, one device program.
+
+Checking-as-a-service shape (ROADMAP open item 4): a manifest names N
+(spec x CONSTANTS) jobs; grouping.py buckets them by packed-state-layout
+compatibility; each bucket runs as ONE compiled program — the host
+engine co-resides every job in a shared frontier (BFSChecker.run_fleet,
+a config axis embedded in the state vector), the device engines queue
+jobs through one jit cache with per-job checkpoint lineages
+(DeviceBFS/ShardedBFS.run_fleet). The CLI subcommand is
+``raft_tpu sweep MANIFEST.json``.
+
+    from raft_tpu.fleet import parse_manifest, run_sweep, SweepOptions
+    res = run_sweep(parse_manifest("sweep.json"), SweepOptions())
+    print(res.rc, res.amortization)
+"""
+
+from .driver import SweepOptions, run_sweep
+from .grouping import FLEET_DYN, FleetGroup, group_jobs
+from .manifest import (
+    FleetJob,
+    FleetManifest,
+    ManifestError,
+    cfg_for_job,
+    parse_manifest,
+    parse_manifest_obj,
+)
+from .packer import build_packed
+from .results import FleetResult, JobResult
+
+__all__ = [
+    "FLEET_DYN",
+    "FleetGroup",
+    "FleetJob",
+    "FleetManifest",
+    "FleetResult",
+    "JobResult",
+    "ManifestError",
+    "SweepOptions",
+    "build_packed",
+    "cfg_for_job",
+    "group_jobs",
+    "parse_manifest",
+    "parse_manifest_obj",
+    "run_sweep",
+]

@@ -1,0 +1,312 @@
+"""Sweep driver: manifest -> groups -> engines -> FleetResult.
+
+The execution plan per group kind (grouping.py):
+
+- ``packed`` + host engine: ONE BFSChecker.run_fleet over the packed
+  model — every job co-resident in a shared frontier, one compile.
+- ``packed`` + tpu/sharded engine: DeviceBFS/ShardedBFS.run_fleet queue
+  arm — jobs run back-to-back through the packed model's single jit
+  cache (fleet_select picks the job), one compile, per-job checkpoint
+  lineage and job-tagged telemetry.
+- ``serial``: jobs share the first setup's model instance (identical
+  params by construction), so N runs still cost one compile.
+- ``simulate``: checker/simulate.py random walks per job over the
+  group's shared model.
+
+Sweep resume (``--state-dir`` + ``--resume``): ``fleet_state.json``
+records each completed job's rc after every group/job; on resume,
+completed jobs are skipped — except packed host groups, which rerun
+WHOLLY unless every member is done (the co-resident frontier has no
+per-job restart point; per-job device lineages do).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+
+from ..checker.bfs import BFSChecker
+from ..obs import JobTaggedTelemetry
+from .grouping import FleetGroup, group_jobs
+from .manifest import FleetJob, FleetManifest, ManifestError
+from .packer import build_packed
+from .results import FleetResult, JobResult, rc_for
+
+ENGINES = ("host", "tpu", "sharded")
+
+
+@dataclass
+class SweepOptions:
+    engine: str = "host"  # host | tpu | sharded
+    jobs_glob: str | None = None  # fnmatch filter on job names
+    max_depth: int | None = None
+    time_budget_s: float | None = None
+    chunk: int = 1024
+    state_dir: str | None = None  # checkpoints + fleet_state.json
+    resume: bool = False
+    verbose: bool = False
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._=-]", "_", name)
+
+
+def _state_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "fleet_state.json")
+
+
+def _load_completed(opts: SweepOptions) -> dict[str, int]:
+    if not (opts.resume and opts.state_dir):
+        return {}
+    path = _state_path(opts.state_dir)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        return {str(k): int(v) for k, v in json.load(fh)["completed"].items()}
+
+
+def _save_completed(opts: SweepOptions, completed: dict[str, int]) -> None:
+    if not opts.state_dir:
+        return
+    os.makedirs(opts.state_dir, exist_ok=True)
+    path = _state_path(opts.state_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"completed": completed}, fh)
+    os.replace(tmp, path)
+
+
+def _skipped(job: FleetJob, rc: int) -> JobResult:
+    return JobResult(
+        name=job.name, mode=job.mode, rc=rc, seconds=0.0, skipped=True
+    )
+
+
+def _check_result(name: str, r) -> JobResult:
+    """Lower a CheckResult (host/device) or ShardedResult to a JobResult."""
+    viol = getattr(r, "violation", None)
+    if viol is not None:
+        vd = {
+            "invariant": viol.invariant,
+            "global_id": int(viol.global_id),
+            "depth": int(viol.depth),
+        }
+    else:
+        vi = getattr(r, "violation_invariant", None)
+        vd = {"invariant": vi} if vi else None
+    return JobResult(
+        name=name,
+        mode="check",
+        rc=rc_for(r.exit_cause, vd),
+        seconds=float(r.seconds),
+        exit_cause=r.exit_cause,
+        distinct=int(r.distinct),
+        total=int(r.total),
+        depth=int(r.depth),
+        terminal=int(r.terminal),
+        violation=vd,
+        trace_len=len(r.trace) if r.trace else None,
+    )
+
+
+def _make_engine(kind: str, model, setup, opts: SweepOptions):
+    if kind == "host":
+        return BFSChecker(
+            model, invariants=setup.invariants, symmetry=setup.symmetry,
+            chunk=opts.chunk,
+        )
+    if kind == "tpu":
+        from ..checker.device_bfs import DeviceBFS
+
+        return DeviceBFS(
+            model, invariants=setup.invariants, symmetry=setup.symmetry,
+            chunk=opts.chunk,
+        )
+    if kind == "sharded":
+        from ..parallel.sharded import ShardedBFS
+
+        return ShardedBFS(
+            model, invariants=setup.invariants, symmetry=setup.symmetry,
+            chunk=opts.chunk,
+        )
+    raise ManifestError(f"unknown engine {kind!r} (available: {ENGINES})")
+
+
+def _run_simulate_group(group, opts, completed, out) -> int:
+    from ..checker.simulate import Simulator
+
+    model = group.setups[0].model  # identical params -> shared kernels
+    ran = 0
+    for job, setup in zip(group.jobs, group.setups):
+        if opts.resume and job.name in completed:
+            out[job.name] = _skipped(job, completed[job.name])
+            continue
+        sim = Simulator(
+            model,
+            invariants=setup.invariants,
+            walks=int(job.sim["walks"]),
+            max_behavior_depth=int(job.sim["max_behavior_depth"]),
+            seed=int(job.sim["seed"]),
+        )
+        r = sim.run(
+            max_steps=job.sim["max_steps"],
+            time_budget_s=opts.time_budget_s,
+            max_behaviors=job.sim["max_behaviors"],
+            verbose=opts.verbose,
+        )
+        vd = (
+            {
+                "invariant": r.violation.invariant,
+                "walk": int(r.violation.walk),
+                "depth": int(r.violation.depth),
+            }
+            if r.violation
+            else None
+        )
+        out[job.name] = JobResult(
+            name=job.name,
+            mode="simulate",
+            rc=2 if vd else 0,
+            seconds=float(r.seconds),
+            behaviors=int(r.behaviors),
+            steps=int(r.steps),
+            violation=vd,
+            trace_len=len(r.trace) if r.trace else None,
+        )
+        ran += 1
+        completed[job.name] = out[job.name].rc
+        _save_completed(opts, completed)
+    return 1 if ran else 0
+
+
+def _run_serial_group(group, opts, completed, out, telemetry) -> int:
+    model = group.setups[0].model  # identical params -> one jit cache
+    ran = 0
+    for job, setup in zip(group.jobs, group.setups):
+        if opts.resume and job.name in completed:
+            out[job.name] = _skipped(job, completed[job.name])
+            continue
+        eng = _make_engine(opts.engine, model, setup, opts)
+        kw = dict(
+            max_depth=opts.max_depth,
+            verbose=opts.verbose,
+            time_budget_s=opts.time_budget_s,
+        )
+        if telemetry is not None:
+            kw["telemetry"] = JobTaggedTelemetry(telemetry, job.name)
+        if opts.state_dir:
+            ck = os.path.join(
+                opts.state_dir, "ckpt", f"{_safe(job.name)}.ckpt.npz"
+            )
+            os.makedirs(os.path.dirname(ck), exist_ok=True)
+            kw["checkpoint_path"] = ck
+            if opts.resume and os.path.exists(ck):
+                kw["resume"] = ck
+        out[job.name] = _check_result(job.name, eng.run(**kw))
+        ran += 1
+        completed[job.name] = out[job.name].rc
+        _save_completed(opts, completed)
+    return 1 if ran else 0
+
+
+def _run_packed_group(group, opts, completed, out, telemetry) -> int:
+    names = [j.name for j in group.jobs]
+    if opts.resume and all(n in completed for n in names):
+        for job in group.jobs:
+            out[job.name] = _skipped(job, completed[job.name])
+        return 0
+    model = build_packed(group)
+    setup = group.setups[0]
+    eng = _make_engine(opts.engine, model, setup, opts)
+    if opts.engine == "host":
+        # co-resident arm: one shared frontier; no per-job restart
+        # point, so a partially-completed group reruns wholly
+        results = eng.run_fleet(
+            job_names=names,
+            max_depth=opts.max_depth,
+            verbose=opts.verbose,
+            time_budget_s=opts.time_budget_s,
+            telemetry=telemetry,
+        )
+        for name, r in zip(names, results):
+            out[name] = _check_result(name, r)
+    else:
+        skip = tuple(n for n in names if opts.resume and n in completed)
+        ckpt_dir = None
+        if opts.state_dir:
+            ckpt_dir = os.path.join(opts.state_dir, "ckpt")
+            os.makedirs(ckpt_dir, exist_ok=True)
+        results = eng.run_fleet(
+            job_names=names,
+            telemetry=telemetry,
+            checkpoint_dir=ckpt_dir,
+            resume=opts.resume,
+            skip=skip,
+            max_depth=opts.max_depth,
+            verbose=opts.verbose,
+            time_budget_s=opts.time_budget_s,
+        )
+        for job, r in zip(group.jobs, results):
+            out[job.name] = (
+                _skipped(job, completed[job.name])
+                if r is None
+                else _check_result(job.name, r)
+            )
+    for name in names:
+        completed[name] = out[name].rc
+    _save_completed(opts, completed)
+    return 1
+
+
+def run_sweep(
+    manifest: FleetManifest,
+    opts: SweepOptions | None = None,
+    telemetry=None,
+) -> FleetResult:
+    opts = opts or SweepOptions()
+    if opts.engine not in ENGINES:
+        raise ManifestError(
+            f"unknown engine {opts.engine!r} (available: {ENGINES})"
+        )
+    jobs = manifest.jobs
+    if opts.jobs_glob:
+        jobs = [
+            j for j in jobs if fnmatch.fnmatchcase(j.name, opts.jobs_glob)
+        ]
+        if not jobs:
+            raise ManifestError(
+                f"{manifest.path}: --jobs {opts.jobs_glob!r} matches none of "
+                f"{len(manifest.jobs)} jobs"
+            )
+    mf = FleetManifest(path=manifest.path, jobs=jobs)
+    groups = group_jobs(mf)
+    completed = _load_completed(opts)
+    out: dict[str, JobResult] = {}
+    precompiles = 0
+    t0 = time.perf_counter()
+    for gi, group in enumerate(groups):
+        if opts.verbose:
+            print(
+                f"fleet: group {gi + 1}/{len(groups)} kind={group.kind} "
+                f"jobs={len(group.jobs)} dyn={list(group.dyn_consts)}"
+            )
+        if group.kind == "simulate":
+            precompiles += _run_simulate_group(group, opts, completed, out)
+        elif group.kind == "serial":
+            precompiles += _run_serial_group(
+                group, opts, completed, out, telemetry
+            )
+        else:
+            precompiles += _run_packed_group(
+                group, opts, completed, out, telemetry
+            )
+    return FleetResult(
+        jobs=[out[j.name] for j in mf.jobs],
+        groups=len(groups),
+        precompiles=precompiles,
+        seconds=time.perf_counter() - t0,
+    )

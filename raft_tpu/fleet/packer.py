@@ -1,0 +1,45 @@
+"""Build the one packed model a "packed" FleetGroup runs as.
+
+The packed model is the group's representative params with the config
+axis switched on: ``fleet=True`` adds the ``fleet_job`` + ``c_<name>``
+VIEW lanes to the layout (models/base.py FleetConstMixin), each varying
+constant is set to its per-group MAXIMUM (fleet_bind asserts this — the
+static value sizes capacity, the lane gates guards), and the per-job
+constant table is bound so ``init_states`` stamps one job-major copy of
+the initial frontier per job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .grouping import FleetGroup
+
+
+def build_packed(group: FleetGroup):
+    assert group.kind == "packed", group.kind
+    setups = group.setups
+    m0 = setups[0].model
+    p0 = m0.p
+    over = {
+        n: max(int(getattr(s.model.p, n)) for s in setups)
+        for n in group.dyn_consts
+    }
+    rep = dataclasses.replace(
+        p0, fleet=True, dyn_consts=tuple(group.dyn_consts), **over
+    )
+    model = type(m0)(
+        rep,
+        server_names=list(setups[0].server_names),
+        value_names=list(setups[0].value_names),
+    )
+    # variant builders rename post-construction (e.g. FlexibleRaft,
+    # models/registry.py:99) — mirror that on the packed instance
+    model.name = m0.name
+    table = group.table
+    if table is None:
+        table = np.zeros((len(setups), 0), dtype=np.int64)
+    model.fleet_bind(table)
+    return model

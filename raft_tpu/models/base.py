@@ -124,6 +124,114 @@ class ActionLabelMixin:
         return f"{name}{binding}"
 
 
+FLEET_JOB = "fleet_job"
+
+
+class FleetConstMixin:
+    """Fleet packing: a config axis embedded in the state vector.
+
+    A fleet-packed model carries two kinds of extra VIEW scalar fields
+    (added by the lowering's ``_build_layout`` when ``params.fleet``):
+
+      fleet_job   which manifest job a state belongs to. Because it is a
+                  VIEW field, fingerprints of different jobs never
+                  collide, so many jobs share one frontier / seen-set /
+                  journal without any cross-job dedup.
+      c_<name>    one lane per *dynamic* constant in ``params.dyn_consts``
+                  (e.g. ``c_max_restarts``). Guards read the lane via
+                  ``_cv`` instead of the static param, so one compiled
+                  program serves every CONSTANTS point in the group.
+
+    The lanes are inserted after the message-bag fields and before the
+    first aux field — scalar kind, so the symmetry canonicalizer leaves
+    them alone (PullRaft's ``acked``-after-``msg_cnt`` field pins that
+    this position is legal).
+
+    Subclass contract: every lowering's ``init_states`` ends with
+    ``return self._fleet_stamp(vec)`` (identity when no fleet table is
+    bound), and every guard that reads a dynamic constant goes through
+    ``self._cv(d, name)`` / ``self._cv_batch(states, name)``.
+    """
+
+    def fleet_bind(self, table) -> None:
+        """Bind the per-job dynamic-constant table.
+
+        ``table`` is [J, len(params.dyn_consts)] ints: row j holds job
+        j's value for each dynamic constant, in ``dyn_consts`` order.
+        The static params must be the element-wise max over the table
+        (capacity sizing — e.g. ``max_term`` — is derived from them)."""
+        table = np.asarray(table, np.int64)
+        dyn = tuple(self.p.dyn_consts)
+        if table.ndim != 2 or table.shape[1] != len(dyn):
+            raise ValueError(
+                f"fleet table must be [J, {len(dyn)}] for dyn_consts {dyn}"
+            )
+        for k, name in enumerate(dyn):
+            cap = int(getattr(self.p, name))
+            hi = int(table[:, k].max()) if len(table) else 0
+            if hi > cap:
+                raise ValueError(
+                    f"fleet table {name} max {hi} exceeds static param {cap}"
+                    " (representative params must be the per-constant max)"
+                )
+        self._fleet_table = table
+        self._fleet_sel: int | None = None
+
+    @property
+    def fleet_jobs(self) -> int:
+        t = getattr(self, "_fleet_table", None)
+        return 0 if t is None else len(t)
+
+    def fleet_select(self, j: int | None) -> None:
+        """Restrict ``init_states`` stamping to job ``j`` (None = all
+        jobs). The queue arm runs jobs one at a time through the SAME
+        compiled program by re-selecting between runs."""
+        if getattr(self, "_fleet_table", None) is None:
+            raise ValueError("fleet_select before fleet_bind")
+        self._fleet_sel = j
+
+    def fleet_job_of(self, states) -> np.ndarray:
+        """[n] job index of each row of a [n, W] state batch."""
+        off = self.layout.fields[FLEET_JOB].offset
+        return np.asarray(states)[..., off]
+
+    def _fleet_stamp(self, vec: np.ndarray) -> np.ndarray:
+        """Stamp init states with the job lane and constant lanes, one
+        copy per selected job, job-major. Identity when unbound, so
+        serial (non-fleet) models are untouched."""
+        table = getattr(self, "_fleet_table", None)
+        if table is None:
+            return vec
+        lay = self.layout
+        sel = getattr(self, "_fleet_sel", None)
+        jobs = range(len(table)) if sel is None else [sel]
+        out = []
+        for j in jobs:
+            v = vec.copy()
+            v[:, lay.fields[FLEET_JOB].offset] = j
+            for k, name in enumerate(self.p.dyn_consts):
+                v[:, lay.fields["c_" + name].offset] = int(table[j, k])
+            out.append(v)
+        return np.concatenate(out, axis=0)
+
+    def _cv(self, d: dict, name: str):
+        """A constant's value inside a per-state kernel: the state lane
+        when fleet-packed, the static param otherwise (bit-identical to
+        the pre-fleet guards in the serial case)."""
+        key = "c_" + name
+        if key in self.layout.fields:
+            return d[key]
+        return getattr(self.p, name)
+
+    def _cv_batch(self, states, name: str):
+        """Batched form of ``_cv`` for invariant/liveness kernels that
+        work on [..., W] state batches rather than decoded dicts."""
+        key = "c_" + name
+        if key in self.layout.fields:
+            return self.layout.get(states, key)
+        return getattr(self.p, name)
+
+
 @dataclass(frozen=True)
 class SparseGroup:
     """One contiguous run of same-named bindings in ``self.bindings``:

@@ -33,6 +33,7 @@ from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
 from .base import (
     ActionLabelMixin,
+    FleetConstMixin,
     Layout,
     SparseExpandMixin,
     messages_are_valid_kernel,
@@ -97,6 +98,10 @@ class PullRaftParams:
     # headroom above |Value| for stale-response appends (see module note);
     # 0 means auto (n_values + 4). Overflow is a hard error either way.
     max_log_override: int = 0
+    # Fleet packing (models/base.py FleetConstMixin), same contract as
+    # RaftParams: guards for dyn_consts read per-state lanes.
+    dyn_consts: tuple = ()
+    fleet: bool = False
 
     @property
     def max_term(self) -> int:
@@ -131,6 +136,12 @@ def _build_layout(p: PullRaftParams) -> Layout:
     lay.add("msg_hi", "msg_hi", (M,))
     lay.add("msg_lo", "msg_lo", (M,))
     lay.add("msg_cnt", "msg_cnt", (M,))
+    if p.fleet:
+        # Fleet config axis (models/base.py FleetConstMixin): VIEW
+        # scalars, before the first aux field in either variant.
+        lay.add("fleet_job", "scalar")
+        for nm in p.dyn_consts:
+            lay.add("c_" + nm, "scalar")
     # acked is IN the view for PullRaft (PullRaft.tla:123) but aux for
     # Variant2 (PullRaftVariant2.tla:114)
     lay.add("acked", "aux" if p.variant2 else "scalar", (V,))
@@ -165,7 +176,7 @@ def _build_packer(p: PullRaftParams) -> BitPacker:
     )
 
 
-class PullRaftModel(SparseExpandMixin, ActionLabelMixin):
+class PullRaftModel(SparseExpandMixin, FleetConstMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants)."""
 
     name = "PullRaft"
@@ -255,7 +266,7 @@ class PullRaftModel(SparseExpandMixin, ActionLabelMixin):
         clears leader and votesLastEntry."""
         p, S = self.p, self.p.n_servers
         d = self._dec(s)
-        valid = d["restartCtr"] < p.max_restarts
+        valid = d["restartCtr"] < self._cv(d, "max_restarts")
         upd = dict(
             state=d["state"].at[i].set(FOLLOWER),
             votesGranted=d["votesGranted"].at[i].set(0),
@@ -277,7 +288,7 @@ class PullRaftModel(SparseExpandMixin, ActionLabelMixin):
         p, S = self.p, self.p.n_servers
         d = self._dec(s)
         st_i = d["state"][i]
-        valid = (d["electionCtr"] < p.max_elections) & (
+        valid = (d["electionCtr"] < self._cv(d, "max_elections")) & (
             (st_i == FOLLOWER) | (st_i == CANDIDATE)
         )
         new_term = d["currentTerm"][i] + 1
@@ -685,7 +696,7 @@ class PullRaftModel(SparseExpandMixin, ActionLabelMixin):
         vec[0, lay.sl("currentTerm")] = 1
         vec[0, lay.sl("msg_hi")] = int(EMPTY)
         vec[0, lay.sl("msg_lo")] = int(EMPTY)
-        return vec
+        return self._fleet_stamp(vec)
 
     # ---------------- invariants (PullRaft.tla:578-627) ----------------
 

@@ -31,6 +31,7 @@ from ..ops import bag
 from ..ops.packing import EMPTY, BitPacker, bits_for
 from .base import (
     ActionLabelMixin,
+    FleetConstMixin,
     Layout,
     SparseExpandMixin,
     messages_are_valid_kernel,
@@ -120,6 +121,18 @@ class RaftParams:
     fsync_leader_before_ae: bool = False  # LeaderFsyncBeforeAppendEntries
     fsync_leader_quorum: bool = False  # LeaderFsyncBeforeIncludeInQuorum
     fsync_follower_reply: bool = False  # FollowerFsyncBeforeReply
+    # Opt-in network-fault actions (Raft.tla:508-523, commented out of
+    # Next at :540-541): DuplicateMessage re-delivers a bag record,
+    # DropMessage discards one delivery. Duplication is bounded by
+    # max_msg_copies per record (the unbounded TLA+ form has an infinite
+    # state space; documented divergence).
+    net_faults: bool = False
+    max_msg_copies: int = 2
+    # Fleet packing (models/base.py FleetConstMixin): dyn_consts names
+    # the params whose guards read a per-state lane instead of the
+    # static value; fleet adds the job + constant lanes to the layout.
+    dyn_consts: tuple = ()
+    fleet: bool = False
 
     @property
     def max_term(self) -> int:
@@ -152,6 +165,12 @@ def _build_layout(p: RaftParams) -> Layout:
     lay.add("msg_hi", "msg_hi", (M,))
     lay.add("msg_lo", "msg_lo", (M,))
     lay.add("msg_cnt", "msg_cnt", (M,))
+    if p.fleet:
+        # Fleet config axis (models/base.py FleetConstMixin): VIEW
+        # scalars so jobs never dedup against each other.
+        lay.add("fleet_job", "scalar")
+        for nm in p.dyn_consts:
+            lay.add("c_" + nm, "scalar")
     # aux (VIEW-excluded: Raft.tla:60-68,115)
     lay.add("acked", "aux", (V,))
     lay.add("electionCtr", "aux")
@@ -191,7 +210,7 @@ def cached_model(params: "RaftParams") -> "RaftModel":
     return _cached_model(params)
 
 
-class RaftModel(SparseExpandMixin, ActionLabelMixin):
+class RaftModel(SparseExpandMixin, FleetConstMixin, ActionLabelMixin):
     """Vectorized successor/invariant kernels for one (spec, constants) pair."""
 
     name = "Raft"
@@ -203,6 +222,12 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         self.ACTION_NAMES = (
             list(ACTION_NAMES) if params.has_fsync else list(ACTION_NAMES[:12])
         )
+        if params.net_faults:
+            # Raft.tla:508-523 (commented out of Next at :540-541):
+            # opt-in ranks appended past the variant's standard table.
+            self._r_dup = len(self.ACTION_NAMES)
+            self._r_drop = self._r_dup + 1
+            self.ACTION_NAMES += ["DuplicateMessage", "DropMessage"]
         self.layout = _build_layout(params)
         self.packer = _build_packer(params)
         S, V, M = params.n_servers, params.n_values, params.msg_slots
@@ -241,6 +266,11 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
                 self.bindings.append(("AdvanceFsyncIndex", (i,)))
         for m in range(M):
             self.bindings.append(("HandleMessage", (m,)))
+        if params.net_faults:
+            for m in range(M):
+                self.bindings.append(("DuplicateMessage", (m,)))
+            for m in range(M):
+                self.bindings.append(("DropMessage", (m,)))
         self.A = len(self.bindings)
 
         self.expand = jax.jit(jax.vmap(self._expand1))
@@ -301,7 +331,7 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         Len' = min(Len, fsyncIndex)."""
         p, S = self.p, self.p.n_servers
         d = self._dec(s)
-        valid = d["restartCtr"] < p.max_restarts
+        valid = d["restartCtr"] < self._cv(d, "max_restarts")
         upd = dict(
             state=d["state"].at[i].set(FOLLOWER),
             votesGranted=d["votesGranted"].at[i].set(0),
@@ -331,7 +361,7 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         p = self.p
         d = self._dec(s)
         st_i = d["state"][i]
-        valid = (d["electionCtr"] < p.max_elections) & (
+        valid = (d["electionCtr"] < self._cv(d, "max_elections")) & (
             (st_i == FOLLOWER) | (st_i == CANDIDATE)
         )
         succ = self._asm(
@@ -376,7 +406,7 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         p, S = self.p, self.p.n_servers
         d = self._dec(s)
         st_i = d["state"][i]
-        valid = (d["electionCtr"] < p.max_elections) & (
+        valid = (d["electionCtr"] < self._cv(d, "max_elections")) & (
             (st_i == FOLLOWER) | (st_i == CANDIDATE)
         )
         new_term = d["currentTerm"][i] + 1
@@ -547,6 +577,33 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
             )
         succ = self._asm(d, **upd)
         return valid, succ, jnp.int32(R_APPENDENTRIES), ovf & valid
+
+    # -------- network-fault kernels (opt-in, params.net_faults) --------
+
+    def _duplicate_message(self, s, m):
+        """DuplicateMessage(m) — Raft.tla:508-515: re-deliver a record
+        already in the bag DOMAIN (Duplicate == count + 1). The TLA+
+        form is unbounded; we gate on count < max_msg_copies so the
+        state space stays finite (documented divergence)."""
+        p = self.p
+        d = self._dec(s)
+        cnt = d["msg_cnt"]
+        occupied = d["msg_hi"][m] != EMPTY
+        valid = occupied & (cnt[m] >= 1) & (cnt[m] < p.max_msg_copies)
+        oh = (jnp.arange(p.msg_slots, dtype=jnp.int32) == m).astype(jnp.int32)
+        succ = self._asm(d, msg_cnt=cnt + oh)
+        return valid, succ, jnp.int32(self._r_dup), jnp.asarray(False)
+
+    def _drop_message(self, s, m):
+        """DropMessage(m) — Raft.tla:517-523: Discard one delivery of a
+        receivable record. The DOMAIN keeps the count-0 record, exactly
+        like the receipt kernels' bag_discard (ops/bag.py)."""
+        d = self._dec(s)
+        cnt = d["msg_cnt"]
+        occupied = d["msg_hi"][m] != EMPTY
+        valid = occupied & (cnt[m] >= 1)
+        succ = self._asm(d, msg_cnt=bag.bag_discard_at(cnt, m))
+        return valid, succ, jnp.int32(self._r_drop), jnp.asarray(False)
 
     # -------- fused message-receipt kernel (slot m) --------
     # The six receipt disjuncts of Next (Raft.tla:534-539) are mutually
@@ -805,6 +862,10 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         outs.append(
             jax.vmap(lambda m: self._handle_message(s, m))(jnp.arange(M, dtype=jnp.int32))
         )
+        if p.net_faults:
+            iota_m = jnp.arange(M, dtype=jnp.int32)
+            outs.append(jax.vmap(lambda m: self._duplicate_message(s, m))(iota_m))
+            outs.append(jax.vmap(lambda m: self._drop_message(s, m))(iota_m))
         valid = jnp.concatenate([o[0] for o in outs])
         succs = jnp.concatenate([o[1] for o in outs])
         rank = jnp.concatenate([o[2] for o in outs])
@@ -825,7 +886,7 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         vec[0, lay.sl("msg_hi")] = int(EMPTY)
         vec[0, lay.sl("msg_lo")] = int(EMPTY)
         vec[0, lay.sl("acked")] = ACK_NIL
-        return vec
+        return self._fleet_stamp(vec)
 
     # ---------------- invariants ----------------
     # Each maps states [B, W] -> ok bool [B] (True = invariant holds).
@@ -876,7 +937,7 @@ class RaftModel(SparseExpandMixin, ActionLabelMixin):
         all_have = jnp.all(has_v, axis=1)
         none_have = ~jnp.any(has_v, axis=1)
         no_leader = ~jnp.any(st == LEADER, axis=1)
-        spent = ec == self.p.max_elections
+        spent = ec == self._cv_batch(states, "max_elections")
         return (spent & no_leader) | all_have | none_have
 
     def _inv_committed_majority(self, states):

@@ -48,7 +48,9 @@ def _check_invariants(cfg: Cfg, model) -> None:
         raise CfgError(f"{cfg.path}: unknown invariant(s) {unknown}")
 
 
-def build_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+def build_raft(
+    cfg: Cfg, msg_slots: int | None = None, net_faults: bool = False
+) -> CheckSetup:
     """standard-raft/Raft.tla + Raft.cfg."""
     servers = cfg.server_like("Server")
     values = cfg.server_like("Value")
@@ -58,6 +60,7 @@ def build_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         max_elections=_require_int(cfg, "MaxElections"),
         max_restarts=_require_int(cfg, "MaxRestarts"),
         msg_slots=msg_slots if msg_slots is not None else 48,
+        net_faults=net_faults,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     _check_invariants(cfg, model)
@@ -70,7 +73,9 @@ def build_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     )
 
 
-def build_flexible_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+def build_flexible_raft(
+    cfg: Cfg, msg_slots: int | None = None, net_faults: bool = False
+) -> CheckSetup:
     """flexible-raft/FlexibleRaft.tla + FlexibleRaft.cfg: structurally core
     Raft with count-based quorums (FlexibleRaft.tla:262,296), strictly
     send-once messaging (:127-151), no pendingResponse (:109), and
@@ -88,6 +93,7 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         strict_send_once=True,
         has_pending_response=False,
         trunc_term_mismatch=True,
+        net_faults=net_faults,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     model.name = "FlexibleRaft"
@@ -101,7 +107,9 @@ def build_flexible_raft(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
     )
 
 
-def build_raft_fsync(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
+def build_raft_fsync(
+    cfg: Cfg, msg_slots: int | None = None, net_faults: bool = False
+) -> CheckSetup:
     """raft-and-fsync/RaftFsync.tla + RaftFsync.cfg: core Raft plus
     fsyncIndex durability (RaftFsync.tla:92), crash-truncation restart
     (:203-218), split Timeout/RequestVote (:222-243), AdvanceFsyncIndex
@@ -122,6 +130,7 @@ def build_raft_fsync(cfg: Cfg, msg_slots: int | None = None) -> CheckSetup:
         fsync_leader_before_ae=_require_bool(cfg, "LeaderFsyncBeforeAppendEntries"),
         fsync_leader_quorum=_require_bool(cfg, "LeaderFsyncBeforeIncludeInQuorum"),
         fsync_follower_reply=_require_bool(cfg, "FollowerFsyncBeforeReply"),
+        net_faults=net_faults,
     )
     model = RaftModel(params, server_names=servers, value_names=values)
     model.name = "RaftFsync"
@@ -377,7 +386,17 @@ def oracle_for_setup(setup: CheckSetup):
     return oracle_for(p)
 
 
-def build_from_cfg(cfg: Cfg, spec: str | None = None, msg_slots: int | None = None) -> CheckSetup:
+# Spec families whose lowering implements the opt-in DuplicateMessage /
+# DropMessage kernels (Raft.tla:508-523).
+NET_FAULT_SPECS = ("Raft", "FlexibleRaft", "RaftFsync")
+
+
+def build_from_cfg(
+    cfg: Cfg,
+    spec: str | None = None,
+    msg_slots: int | None = None,
+    net_faults: bool = False,
+) -> CheckSetup:
     import os
 
     name = spec or os.path.splitext(os.path.basename(cfg.path))[0]
@@ -386,4 +405,12 @@ def build_from_cfg(cfg: Cfg, spec: str | None = None, msg_slots: int | None = No
             f"no TPU lowering registered for spec {name!r} "
             f"(available: {', '.join(sorted(BUILDERS))})"
         )
+    if net_faults:
+        if name not in NET_FAULT_SPECS:
+            raise CfgError(
+                f"{cfg.path}: --net-faults is only lowered for the Raft "
+                f"family (available: {', '.join(NET_FAULT_SPECS)}), not "
+                f"{name!r}"
+            )
+        return BUILDERS[name](cfg, msg_slots=msg_slots, net_faults=True)
     return BUILDERS[name](cfg, msg_slots=msg_slots)

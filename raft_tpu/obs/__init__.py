@@ -12,7 +12,12 @@ and TLC-style per-action coverage rendering (coverage.py).
     tel.close()
 """
 
-from .collector import MetricsCollector, NULL_TELEMETRY, Telemetry
+from .collector import (
+    JobTaggedTelemetry,
+    MetricsCollector,
+    NULL_TELEMETRY,
+    Telemetry,
+)
 from .coverage import coverage_digest, dead_actions, render_coverage_table
 from .events import (
     CKPT_GENERATION_KEYS,
@@ -47,6 +52,7 @@ __all__ = [
     "STALL_KEYS",
     "SUMMARY_KEYS",
     "WAVE_KEYS",
+    "JobTaggedTelemetry",
     "MetricsCollector",
     "NULL_TELEMETRY",
     "ProgressRenderer",

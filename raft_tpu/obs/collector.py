@@ -269,6 +269,57 @@ class Telemetry:
         return False
 
 
+class JobTaggedTelemetry:
+    """Proxy that stamps a ``job`` field onto every event it forwards.
+
+    The fleet queue arm (DeviceBFS/ShardedBFS.run_fleet) wraps the
+    caller's Telemetry with one of these per job, so N sequential runs
+    multiplex into ONE metrics stream that obs_report and
+    check_metrics_schema can split back out per job. ``close()`` is a
+    no-op — the owner of the inner Telemetry closes it once after the
+    whole fleet."""
+
+    def __init__(self, inner, job: str):
+        self._inner = inner if inner is not None else NULL_TELEMETRY
+        self.job = job
+
+    @property
+    def active(self) -> bool:
+        return self._inner.active
+
+    def open_run(self, manifest: dict) -> None:
+        self._inner.open_run({**manifest, "job": self.job})
+
+    def wave(self, fields: dict) -> None:
+        self._inner.wave({**fields, "job": self.job})
+
+    def coverage(self, fields: dict, final: bool = False) -> None:
+        self._inner.coverage({**fields, "job": self.job}, final=final)
+
+    def event(self, etype: str, **fields) -> None:
+        self._inner.event(etype, job=self.job, **fields)
+
+    def close_run(self, summary: dict) -> None:
+        self._inner.close_run({**summary, "job": self.job})
+
+    def wave_annotation(self, depth: int):
+        return self._inner.wave_annotation(depth)
+
+    def annotate(self, name: str):
+        return self._inner.annotate(name)
+
+    @property
+    def events(self):
+        return self._inner.events
+
+    @property
+    def last_summary(self):
+        return self._inner.last_summary
+
+    def close(self) -> None:
+        pass
+
+
 class _NullTelemetry:
     """Shared inert instance: the engines' default, so the wave loop
     calls methods unconditionally instead of branching on None."""

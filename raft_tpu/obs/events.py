@@ -36,6 +36,17 @@ plumbing changes. Engines may add EXTRA keys (e.g. the sharded checker's
 all-to-all volume and per-shard skew); every DECLARED key must be
 present. This module is dependency-free (no jax/numpy) so schema
 validation runs anywhere — see scripts/check_metrics_schema.py.
+
+``job`` is the reserved extra key of fleet sweeps (raft_tpu/fleet/):
+``raft_tpu sweep`` multiplexes every job of a manifest into ONE stream,
+and each job-attributed event carries its job name there — the queue arm
+stamps it on every forwarded event (obs/collector.py
+JobTaggedTelemetry), the packed host arm synthesizes one per-job
+manifest/coverage/summary triple after the shared group run. When
+present it must be a non-empty string, each job's wave indices must be
+strictly increasing within its run, and every job manifest must be
+answered by exactly one summary with the same tag (validate_lines
+enforces all three).
 """
 
 from __future__ import annotations
@@ -178,6 +189,10 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
         problems.append(
             f"{where}{etype} event missing declared keys: {missing}"
         )
+    if "job" in ev and (not isinstance(ev["job"], str) or not ev["job"]):
+        problems.append(
+            f"{where}job tag {ev['job']!r} must be a non-empty string"
+        )
     if etype == "wave":
         dens = ev.get("enabled_density")
         if dens is not None and (
@@ -263,6 +278,11 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     attempts must be strictly increasing across a supervised session (a
     summary ends the session and resets the counter — a completed run
     means any later retry belongs to a new invocation).
+
+    Job-tagged streams (fleet sweeps) add: per-job wave indices must be
+    strictly increasing within that job's run (its ``job``-tagged
+    manifest resets the expectation), and every job manifest must be
+    matched by exactly one summary carrying the same job tag.
     """
     counts: dict[str, int] = {}
     problems: list[str] = []
@@ -271,6 +291,9 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     last_cov_wave = 0
     prev_actions: list | None = None
     last_retry_attempt = 0
+    job_wave: dict[str, int] = {}
+    job_manifests: dict[str, int] = {}
+    job_summaries: dict[str, int] = {}
     for lineno, raw in enumerate(lines, start=1):
         raw = raw.strip()
         if not raw:
@@ -285,11 +308,16 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
         if etype not in EVENT_KEYS:
             continue
         counts[etype] = counts.get(etype, 0) + 1
+        job = ev.get("job")
+        job = job if isinstance(job, str) and job else None
         if etype == "manifest":
             last_wave = 0
             summarized = False
             last_cov_wave = 0
             prev_actions = None
+            if job is not None:
+                job_manifests[job] = job_manifests.get(job, 0) + 1
+                job_wave[job] = 0
         elif etype == "coverage":
             if summarized:
                 problems.append(
@@ -330,6 +358,15 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
                 )
             else:
                 last_wave = w
+            if job is not None and isinstance(w, int):
+                if w <= job_wave.get(job, 0):
+                    problems.append(
+                        f"line {lineno}: job {job!r} wave index {w} not "
+                        f"strictly increasing "
+                        f"(previous {job_wave.get(job, 0)})"
+                    )
+                else:
+                    job_wave[job] = w
         elif etype == "retry":
             att = ev.get("attempt")
             if isinstance(att, int) and not isinstance(att, bool):
@@ -343,4 +380,15 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
         elif etype == "summary":
             summarized = True
             last_retry_attempt = 0
+            if job is not None:
+                job_summaries[job] = job_summaries.get(job, 0) + 1
+    for job in sorted(set(job_manifests) | set(job_summaries)):
+        nm = job_manifests.get(job, 0)
+        ns = job_summaries.get(job, 0)
+        if nm != ns:
+            problems.append(
+                f"job {job!r}: {nm} manifest(s) but {ns} summar"
+                f"{'y' if ns == 1 else 'ies'} (one summary per job "
+                f"manifest)"
+            )
     return counts, problems

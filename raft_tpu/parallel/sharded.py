@@ -1087,6 +1087,60 @@ class ShardedBFS:
             exit_cause=exit_cause,
         )
 
+    def run_fleet(
+        self,
+        job_names: list[str] | None = None,
+        telemetry=None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every_s: float = 300.0,
+        checkpoint_keep: int = rckpt.DEFAULT_KEEP,
+        resume: bool = False,
+        skip: tuple[str, ...] = (),
+        **run_kw,
+    ) -> list:
+        """Fleet queue arm over all shards: same contract as
+        DeviceBFS.run_fleet — sequential jobs through one engine
+        instance (``fleet_select`` swaps only the stamped init states,
+        so the sharded programs compile once per group), job-tagged
+        telemetry, and one checkpoint lineage per job under
+        ``checkpoint_dir``."""
+        import os
+        import re as _re
+
+        from ..obs.collector import JobTaggedTelemetry
+
+        model = self.model
+        J = model.fleet_jobs
+        if J == 0:
+            raise ValueError(
+                "run_fleet needs a fleet-bound model (fleet_bind)"
+            )
+        names = list(job_names) if job_names else [f"job{j}" for j in range(J)]
+        if len(names) != J:
+            raise ValueError(f"{len(names)} job names for {J} jobs")
+        results = []
+        try:
+            for j, name in enumerate(names):
+                if name in skip:
+                    results.append(None)
+                    continue
+                model.fleet_select(j)
+                kw = dict(run_kw)
+                if telemetry is not None:
+                    kw["telemetry"] = JobTaggedTelemetry(telemetry, name)
+                if checkpoint_dir is not None:
+                    safe = _re.sub(r"[^A-Za-z0-9._=-]", "_", name)
+                    ck = os.path.join(checkpoint_dir, f"{safe}.ckpt.npz")
+                    kw.setdefault("checkpoint_path", ck)
+                    kw.setdefault("checkpoint_every_s", checkpoint_every_s)
+                    kw.setdefault("checkpoint_keep", checkpoint_keep)
+                    if resume and os.path.exists(ck):
+                        kw.setdefault("resume", ck)
+                results.append(self.run(**kw))
+        finally:
+            model.fleet_select(None)
+        return results
+
     def _coverage_fields(self, depth, cov_hd, scounts, depth_counts) -> dict:
         """Coverage-event payload (obs.events.COVERAGE_KEYS), fleet-summed
         from the per-shard [D, n_actions, 3] counters. Dedup gauges come

@@ -17,8 +17,13 @@ preempt, from the self-healing runtime) are validated too: retry
 attempts must be ints >= 1 strictly increasing across a supervised
 session (a summary resets the counter), backoff_s non-negative,
 resume/ckpt_generation generations ints >= 0, and ckpt_generation
-skipped-diagnostics a list of strings. Exit status 0 iff every file is
-clean — bench.py runs this after each telemetry-enabled run.
+skipped-diagnostics a list of strings. Job-tagged streams (the one
+multiplexed file a `raft_tpu sweep --metrics-out` run writes) get the
+fleet rules: a `job` tag must be a non-empty string, each job's wave
+indices must be strictly increasing within its run, and every job
+manifest must be matched by exactly one summary with the same tag.
+Exit status 0 iff every file is clean — bench.py runs this after each
+telemetry-enabled run.
 
 Dependency-free on purpose (no jax/numpy import happens): schema
 validation must work on a machine with nothing but the repo checked

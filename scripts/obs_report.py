@@ -15,6 +15,15 @@ Usage:
 By default only the LAST run in the file is reported (a stream may hold
 several; each ``manifest`` event starts a new run); --all reports every
 run in order.
+
+Fleet streams (one multiplexed file from ``raft_tpu sweep
+--metrics-out``) carry job-tagged runs — the queue arm's per-job runs
+and the packed arm's synthesized per-job triples all land in the same
+file with a ``job`` field on their events. When any are present, the
+report opens with a fleet digest table (one row per job: exit cause,
+distinct/total/depth/terminal, violation, seconds) built from every
+job-tagged run in the file, and each per-run section is titled with its
+job name.
 """
 
 from __future__ import annotations
@@ -82,6 +91,8 @@ def render_run(events: list[dict]) -> str:
 
     out = []
     title = man.get("model", "unknown model")
+    if man.get("job"):
+        title += f" — job {man['job']}"
     out.append(f"# Telemetry report: {title} ({man.get('engine', '?')})")
     out.append("")
     for k in ("ident", "platform", "device", "device_count", "chunk",
@@ -177,6 +188,38 @@ def render_run(events: list[dict]) -> str:
     return "\n".join(out)
 
 
+def render_fleet_digest(runs: list[list[dict]]) -> str | None:
+    """One table row per job-tagged run in the stream; None when the
+    stream carries no fleet (job-tagged) runs at all."""
+    rows = []
+    for events in runs:
+        man = next((e for e in events if e["event"] == "manifest"), {})
+        job = man.get("job")
+        if not job:
+            continue
+        summ = next((e for e in events if e["event"] == "summary"), None)
+        rows.append((job, summ or {}))
+    if not rows:
+        return None
+    out = ["# Fleet digest", ""]
+    out.append(f"{len(rows)} job run(s) in this stream.")
+    out.append("")
+    out.append(
+        "| job | exit | distinct | total | depth | terminal "
+        "| violation | seconds |"
+    )
+    out.append("|---|---|---:|---:|---:|---:|---|---:|")
+    for job, s in rows:
+        out.append(
+            f"| {job} | {s.get('exit_cause', '?')} "
+            f"| {s.get('distinct', '')} | {s.get('total', '')} "
+            f"| {s.get('depth', '')} | {s.get('terminal', '')} "
+            f"| {s.get('violation') or '-'} | {_fmt(s.get('seconds', ''))} |"
+        )
+    out.append("")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="obs_report",
@@ -195,7 +238,12 @@ def main(argv=None) -> int:
         print(f"error: no telemetry events in {args.path}", file=sys.stderr)
         return 1
     picked = runs if args.all else runs[-1:]
-    text = "\n---\n\n".join(render_run(r) for r in picked)
+    sections = []
+    digest = render_fleet_digest(runs)
+    if digest is not None:
+        sections.append(digest)
+    sections.extend(render_run(r) for r in picked)
+    text = "\n---\n\n".join(sections)
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(text + "\n")

@@ -1,0 +1,513 @@
+"""Fleet checking (raft_tpu/fleet/): manifest parsing, layout grouping,
+packed fleet-vs-serial bit-identical parity (counts AND counterexample
+traces), the sweep CLI exit codes, and job-tagged telemetry validation.
+
+The parity tests are the fleet analog of the oracle differential: every
+job run through the packed config axis must report exactly what a
+standalone run of the same constants reports — the fleet_job lane keeps
+cross-job fingerprints disjoint and first-occurrence dedup is
+fingerprint-value-independent, so any divergence is a packing bug.
+"""
+
+import functools
+import json
+
+import pytest
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.fleet.cli import sweep_main
+from raft_tpu.fleet.driver import SweepOptions, run_sweep
+from raft_tpu.fleet.grouping import FLEET_DYN, build_setup, group_jobs
+from raft_tpu.fleet.manifest import (
+    SIM_DEFAULTS,
+    ManifestError,
+    cfg_for_job,
+    parse_manifest_obj,
+)
+from raft_tpu.fleet.packer import build_packed
+from raft_tpu.models.registry import CfgError, build_from_cfg
+from raft_tpu.obs import EVENT_KEYS, Telemetry, validate_lines
+from raft_tpu.utils.cfg import Cfg, ModelValue
+
+# The standard parity grid: 4 Raft jobs whose MaxElections/MaxRestarts
+# all fit one packed layout (term width bits_for(max_term) agrees).
+STD_MANIFEST = {
+    "spec": "Raft",
+    "defaults": {
+        "constants": {
+            "Server": ["s1", "s2"],
+            "Value": ["v1"],
+            "MaxElections": 1,
+            "MaxRestarts": 0,
+        },
+        "invariants": ["LeaderHasAllAckedValues", "NoLogDivergence"],
+        "msg_slots": 16,
+    },
+    "grid": {"MaxElections": [1, 2], "MaxRestarts": [0, 1]},
+}
+STD_DEPTH = 5
+
+
+def _mf(obj):
+    return parse_manifest_obj(obj, path="<test>")
+
+
+# ---------------- manifest schema ----------------
+
+
+def test_grid_cross_product_order_and_names():
+    mf = _mf(STD_MANIFEST)
+    assert [j.name for j in mf.jobs] == [
+        "Raft-MaxElections=1-MaxRestarts=0",
+        "Raft-MaxElections=1-MaxRestarts=1",
+        "Raft-MaxElections=2-MaxRestarts=0",
+        "Raft-MaxElections=2-MaxRestarts=1",
+    ]
+    j = mf.jobs[1]
+    assert j.spec == "Raft"
+    assert j.constants["MaxElections"] == 1 and j.constants["MaxRestarts"] == 1
+    # defaults merge under the grid point
+    assert j.constants["Server"] == ["s1", "s2"]
+    assert j.invariants == ("LeaderHasAllAckedValues", "NoLogDivergence")
+    assert j.msg_slots == 16 and j.mode == "check" and j.symmetry
+
+
+def test_explicit_jobs_override_defaults():
+    mf = _mf(
+        {
+            "spec": "Raft",
+            "defaults": {
+                "constants": {"Server": ["s1", "s2"], "Value": ["v1"],
+                              "MaxElections": 1, "MaxRestarts": 0},
+                "sim": {"walks": 7},
+            },
+            "jobs": [
+                {"name": "a", "mode": "simulate", "sim": {"seed": 3}},
+                {"name": "b", "constants": {"MaxElections": 2},
+                 "symmetry": False, "net_faults": True},
+            ],
+        }
+    )
+    a, b = mf.jobs
+    assert a.mode == "simulate"
+    assert a.sim["walks"] == 7 and a.sim["seed"] == 3
+    assert a.sim["max_steps"] == SIM_DEFAULTS["max_steps"]
+    assert b.constants["MaxElections"] == 2 and not b.symmetry
+    assert b.net_faults and not a.net_faults
+
+
+@pytest.mark.parametrize(
+    "obj,msg",
+    [
+        ({"spec": "Raft"}, "no jobs"),
+        ({"grid": {"MaxElections": [1]}}, "missing required key 'spec'"),
+        ({"spec": "Raft", "gird": {}}, "unknown manifest keys"),
+        ({"spec": "Raft", "grid": {"MaxElections": []}}, "non-empty lists"),
+        ({"spec": "Raft", "jobs": [{"name": "a", "mode": "walk"}]}, "mode"),
+        ({"spec": "Raft", "jobs": [{"name": "a", "msg_slots": 0}]},
+         "msg_slots"),
+        ({"spec": "Raft", "jobs": [{"constants": {}}]}, "need a name"),
+        ({"spec": "Raft", "jobs": [{"name": "a"}, {"name": "a"}]},
+         "duplicate job names"),
+        ({"spec": "Raft", "jobs": [{"name": "a", "sim": {"wlks": 1}}]},
+         "unknown sim keys"),
+        ({"spec": "Raft", "jobs": [{"name": "a",
+                                    "constants": {"Server": [1, 2]}}]},
+         "constant"),
+    ],
+)
+def test_manifest_errors(obj, msg):
+    with pytest.raises(ManifestError, match=msg):
+        _mf(obj)
+
+
+def test_cfg_for_job_lowers_model_values():
+    mf = _mf(STD_MANIFEST)
+    cfg = cfg_for_job(mf.jobs[0], "m.json")
+    assert isinstance(cfg, Cfg)
+    assert cfg.path == "m.json#Raft-MaxElections=1-MaxRestarts=0"
+    assert cfg.constants["Server"] == (ModelValue("s1"), ModelValue("s2"))
+    assert cfg.constants["MaxElections"] == 1
+    assert cfg.symmetry is not None  # symmetry defaults on
+    no_sym = _mf({"spec": "Raft", "defaults": {"symmetry": False},
+                  "jobs": [{"name": "a"}]})
+    assert cfg_for_job(no_sym.jobs[0]).symmetry is None
+
+
+# ---------------- layout grouping ----------------
+
+
+def test_grouping_shared_term_width_is_one_group():
+    """MaxElections 1 and 2 both pack terms in 2 bits: the whole 4-job
+    grid compiles once."""
+    groups = group_jobs(_mf(STD_MANIFEST))
+    assert len(groups) == 1
+    (g,) = groups
+    assert g.kind == "packed"
+    assert g.dyn_consts == ("max_elections", "max_restarts")
+    assert g.table.shape == (4, 2)
+    assert g.table.tolist() == [[1, 0], [1, 1], [2, 0], [2, 1]]
+
+
+def test_grouping_splits_on_packer_width():
+    """MaxElections 4 needs 3 term bits (max_term 5) — a different
+    message layout, so it cannot share the MaxElections<=2 program."""
+    obj = dict(STD_MANIFEST, grid={"MaxElections": [1, 2, 4]})
+    groups = group_jobs(_mf(obj))
+    assert [len(g.jobs) for g in groups] == [2, 1]
+    assert all(g.kind == "packed" for g in groups)
+
+
+def test_grouping_mixed_specs_and_modes():
+    obj = {
+        "spec": "Raft",
+        "defaults": {
+            "constants": {"Server": ["s1", "s2"], "Value": ["v1"],
+                          "MaxElections": 1, "MaxRestarts": 0},
+            "msg_slots": 16,
+        },
+        "jobs": [
+            {"name": "r1"},
+            {"name": "r2", "constants": {"MaxElections": 2}},
+            {"name": "p1", "spec": "PullRaft", "msg_slots": 24},
+            {"name": "sim1", "mode": "simulate"},
+        ],
+    }
+    groups = group_jobs(_mf(obj))
+    kinds = [(g.kind, [j.name for j in g.jobs]) for g in groups]
+    assert kinds == [
+        ("packed", ["r1", "r2"]),
+        ("packed", ["p1"]),
+        ("simulate", ["sim1"]),
+    ]
+    assert "PullRaftParams" in FLEET_DYN  # p1 rides the packed path too
+
+
+# ---------------- packed fleet vs serial: bit-identical parity ----------
+
+
+# Tier-1 keeps a 2-job gate (3 compiles total); the full 4-job grid and
+# the device queue arm ride the slow set with the other exhaustive
+# host/device parity tests.
+SM_MANIFEST = dict(STD_MANIFEST, grid={"MaxElections": [1, 2]})
+
+
+@functools.lru_cache(maxsize=None)
+def _serial_ref(which: str):
+    """Serial reference for a grid: one standalone checker per job,
+    fresh model each (what N separate CLI runs would do)."""
+    mf = _mf(STD_MANIFEST if which == "std" else SM_MANIFEST)
+    out = {}
+    for job in mf.jobs:
+        setup = build_setup(job, mf.path)
+        res = BFSChecker(
+            setup.model, invariants=setup.invariants,
+            symmetry=setup.symmetry, chunk=512,
+        ).run(max_depth=STD_DEPTH)
+        out[job.name] = res
+    return out
+
+
+def test_fleet_host_coresident_parity():
+    mf = _mf(SM_MANIFEST)
+    (group,) = group_jobs(mf)
+    model = build_packed(group)
+    setup = group.setups[0]
+    names = [j.name for j in group.jobs]
+    results = BFSChecker(
+        model, invariants=setup.invariants, symmetry=setup.symmetry,
+        chunk=512,
+    ).run_fleet(job_names=names, max_depth=STD_DEPTH)
+    serial = _serial_ref("sm")
+    assert len(results) == len(names)
+    for name, r in zip(names, results):
+        s = serial[name]
+        assert r.violation is None and s.violation is None
+        assert (r.distinct, r.total, r.depth, r.terminal) == (
+            s.distinct, s.total, s.depth, s.terminal), name
+        assert r.depth_counts == s.depth_counts, name
+        # the shared-wave bincount split must reproduce per-job coverage
+        assert r.coverage == s.coverage, name
+
+
+@pytest.mark.slow
+def test_fleet_device_queue_parity():
+    """tpu engine queue arm: same packed model, jobs run back-to-back
+    through one jit cache; counts must match the serial host runs."""
+    mf = _mf(STD_MANIFEST)
+    tel = Telemetry()
+    res = run_sweep(
+        mf, SweepOptions(engine="tpu", max_depth=STD_DEPTH, chunk=512),
+        telemetry=tel,
+    )
+    assert res.rc == 0
+    assert res.amortization == {
+        "jobs": 4, "groups": 1, "precompiles": 1, "precompile_ratio": 0.25,
+    }
+    serial = _serial_ref("std")
+    for jr in res.jobs:
+        s = serial[jr.name]
+        assert (jr.distinct, jr.total, jr.depth, jr.terminal) == (
+            s.distinct, s.total, s.depth, s.terminal), jr.name
+        assert jr.rc == 0
+        assert jr.exit_cause in ("max_depth", "exhausted")
+    # one multiplexed stream: schema-clean, one manifest+summary per job
+    lines = [json.dumps(e) for e in tel.events]
+    counts, problems = validate_lines(lines)
+    assert problems == []
+    tagged = {e.get("job") for e in tel.events if e.get("job")}
+    assert tagged == {j.name for j in mf.jobs}
+    for name in tagged:
+        evs = [e for e in tel.events if e.get("job") == name]
+        assert [e["event"] for e in evs].count("manifest") == 1
+        assert [e["event"] for e in evs].count("summary") == 1
+
+
+def _strip_fleet(dec: dict) -> dict:
+    return {
+        k: v for k, v in dec.items()
+        if k != "fleet_job" and not k.startswith("c_")
+    }
+
+
+@pytest.mark.slow
+def test_fleet_violation_trace_parity():
+    """A job that violates mid-sweep must report the SAME shortest
+    counterexample as its standalone run — action labels and decoded
+    states (modulo the packed model's extra config lanes)."""
+    obj = {
+        "spec": "FlexibleRaft",
+        "defaults": {
+            "constants": {
+                "Server": ["s1", "s2"], "Value": ["v1"],
+                "MaxRestarts": 0, "ElectionQuorumSize": 1,
+                "ReplicationQuorumSize": 1,
+            },
+            "invariants": ["LeaderHasAllAckedValues"],
+            "msg_slots": 24,
+        },
+        "grid": {"MaxElections": [1, 2]},
+    }
+    mf = _mf(obj)
+    (group,) = group_jobs(mf)  # one packed group despite the violation
+    model = build_packed(group)
+    setup = group.setups[0]
+    names = [j.name for j in group.jobs]
+    fleet = BFSChecker(
+        model, invariants=setup.invariants, symmetry=setup.symmetry,
+        chunk=512,
+    ).run_fleet(job_names=names)
+    serial = {}
+    for job in mf.jobs:
+        s = build_setup(job, mf.path)
+        serial[job.name] = BFSChecker(
+            s.model, invariants=s.invariants, symmetry=s.symmetry, chunk=512,
+        ).run()
+    clean, bad = fleet
+    # ME=1: single-vote election quorum cannot lose an ack yet — exhausts
+    sref = serial[names[0]]
+    assert clean.violation is None and sref.violation is None
+    assert clean.exhausted and clean.distinct == sref.distinct
+    assert clean.depth_counts == sref.depth_counts
+    # ME=2: the flexible quorums violate LeaderHasAllAckedValues
+    bref = serial[names[1]]
+    assert bad.violation is not None and bref.violation is not None
+    assert bad.violation.invariant == bref.violation.invariant
+    assert bad.violation.depth == bref.violation.depth
+    assert [a for a, _ in bad.trace] == [a for a, _ in bref.trace]
+    for (_, fdec), (_, sdec) in zip(bad.trace, bref.trace):
+        assert _strip_fleet(fdec) == sdec
+
+
+@pytest.mark.slow
+def test_fleet_pull_raft_family_parity():
+    """Second packable family (PullRaftParams): host co-resident AND
+    device queue arms must both match standalone runs."""
+    obj = {
+        "spec": "PullRaft",
+        "defaults": {
+            "constants": {"Server": ["s1", "s2"], "Value": ["v1"],
+                          "MaxElections": 1, "MaxRestarts": 1},
+            "invariants": ["NoLogDivergence", "LeaderHasAllAckedValues"],
+            "msg_slots": 24,
+        },
+        "grid": {"MaxElections": [1, 2]},
+    }
+    mf = _mf(obj)
+    (group,) = group_jobs(mf)
+    assert group.kind == "packed" and group.dyn_consts == ("max_elections",)
+    serial = {}
+    for job in mf.jobs:
+        s = build_setup(job, mf.path)
+        serial[job.name] = BFSChecker(
+            s.model, invariants=s.invariants, symmetry=s.symmetry, chunk=512,
+        ).run(max_depth=STD_DEPTH)
+    for engine in ("host", "tpu"):
+        res = run_sweep(mf, SweepOptions(
+            engine=engine, max_depth=STD_DEPTH, chunk=512,
+        ))
+        assert res.rc == 0 and res.precompiles == 1
+        for jr in res.jobs:
+            s = serial[jr.name]
+            assert (jr.distinct, jr.total, jr.depth, jr.terminal) == (
+                s.distinct, s.total, s.depth, s.terminal), (engine, jr.name)
+
+
+def test_rc_mapping():
+    from raft_tpu.fleet.results import FleetResult, JobResult, rc_for
+
+    assert rc_for("exhausted", None) == 0
+    assert rc_for("max_depth", None) == 0
+    assert rc_for("violation", {"invariant": "NoLogDivergence"}) == 2
+    assert rc_for("preempted", None) == 4
+    assert rc_for("unrecoverable", None) == 5
+    jobs = [
+        JobResult(name="a", mode="check", rc=0, seconds=0.0),
+        JobResult(name="b", mode="check", rc=2, seconds=0.0),
+    ]
+    fr = FleetResult(jobs=jobs, groups=1, precompiles=1, seconds=0.0)
+    assert fr.rc == 2  # worst job wins
+    assert fr.to_json()["jobs"][1]["rc"] == 2
+
+
+# ---------------- sweep driver + resume ----------------
+
+
+def test_run_sweep_host_and_resume(tmp_path):
+    mf = _mf(SM_MANIFEST)
+    opts = SweepOptions(
+        engine="host", max_depth=STD_DEPTH, chunk=512,
+        state_dir=str(tmp_path),
+    )
+    res = run_sweep(mf, opts)
+    assert res.rc == 0 and res.groups == 1 and res.precompiles == 1
+    serial = _serial_ref("sm")
+    for jr in res.jobs:
+        assert jr.distinct == serial[jr.name].distinct, jr.name
+    state = json.loads((tmp_path / "fleet_state.json").read_text())
+    assert state["completed"] == {j.name: 0 for j in mf.jobs}
+    # resume: every job already completed -> nothing recompiles or reruns
+    res2 = run_sweep(mf, SweepOptions(
+        engine="host", max_depth=STD_DEPTH, chunk=512,
+        state_dir=str(tmp_path), resume=True,
+    ))
+    assert res2.precompiles == 0
+    assert all(j.skipped and j.rc == 0 for j in res2.jobs)
+
+
+def test_run_sweep_jobs_glob():
+    mf = _mf(STD_MANIFEST)
+    res = run_sweep(mf, SweepOptions(
+        engine="host", max_depth=3, chunk=512,
+        jobs_glob="*MaxElections=1*",
+    ))
+    assert [j.name for j in res.jobs] == [
+        "Raft-MaxElections=1-MaxRestarts=0",
+        "Raft-MaxElections=1-MaxRestarts=1",
+    ]
+    with pytest.raises(ManifestError, match="matches none"):
+        run_sweep(mf, SweepOptions(jobs_glob="nope-*"))
+
+
+# ---------------- CLI exit codes ----------------
+
+
+def test_sweep_cli_json_roundtrip(tmp_path, capsys):
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps(STD_MANIFEST))
+    rc = sweep_main([str(path), "--max-depth", "3", "--json",
+                     "--jobs", "*MaxRestarts=0*"])
+    assert rc == 0
+    lines = [json.loads(x) for x in capsys.readouterr().out.splitlines() if x]
+    assert [x["job"] for x in lines[:-1]] == [
+        "Raft-MaxElections=1-MaxRestarts=0",
+        "Raft-MaxElections=2-MaxRestarts=0",
+    ]
+    agg = lines[-1]
+    assert agg["fleet"] is True and agg["rc"] == 0
+    assert agg["amortization"]["precompiles"] == 1
+
+
+def test_sweep_cli_usage_errors(tmp_path):
+    missing = tmp_path / "nope.json"
+    assert sweep_main([str(missing)]) == 66
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert sweep_main([str(bad)]) == 64
+    unknown = tmp_path / "unknown.json"
+    unknown.write_text(json.dumps({
+        "spec": "Bogus",
+        "jobs": [{"name": "a", "constants": {
+            "Server": ["s1"], "Value": ["v1"],
+            "MaxElections": 1, "MaxRestarts": 0}}],
+    }))
+    assert sweep_main([str(unknown)]) == 64
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(STD_MANIFEST))
+    # --resume without a --state-dir to resume from is a usage error
+    assert sweep_main([str(ok), "--resume"]) == 64
+
+
+def test_build_from_cfg_unknown_spec_diagnostic():
+    cfg = Cfg(path="x.cfg", constants={}, symmetry=None, invariants=[],
+              model_values=[])
+    with pytest.raises(CfgError) as ei:
+        build_from_cfg(cfg, spec="Bogus")
+    msg = str(ei.value)
+    assert "no TPU lowering registered for spec 'Bogus'" in msg
+    # the diagnostic must enumerate what IS available
+    for name in ("Raft", "PullRaft", "KRaftWithReconfig"):
+        assert name in msg
+
+
+# ---------------- job-tagged stream validation ----------------
+
+
+def _ev(etype, **extra):
+    ev = dict.fromkeys(EVENT_KEYS[etype])
+    ev["event"] = etype
+    if etype == "summary":
+        ev["exit_cause"] = "exhausted"
+    if etype == "wave":
+        ev["wave"] = 1
+    if etype == "coverage":
+        ev.update(actions=[], actions_total=0, wave=0)
+    ev.update(extra)
+    return json.dumps(ev)
+
+
+def test_validate_lines_accepts_multiplexed_jobs():
+    lines = [
+        _ev("manifest", job="a"), _ev("wave", wave=1, job="a"),
+        _ev("summary", job="a"),
+        _ev("manifest", job="b"), _ev("wave", wave=1, job="b"),
+        _ev("summary", job="b"),
+    ]
+    counts, problems = validate_lines(lines)
+    assert problems == []
+    assert counts["manifest"] == counts["summary"] == 2
+
+
+def test_validate_lines_flags_per_job_wave_regression():
+    # job a's second run re-emits wave 1 without a new job-a manifest:
+    # legal globally (the job-b manifest reset the stream counter) but
+    # a per-job monotonicity break
+    lines = [
+        _ev("manifest", job="a"), _ev("wave", wave=1, job="a"),
+        _ev("summary", job="a"),
+        _ev("manifest", job="b"), _ev("wave", wave=1, job="a"),
+        _ev("summary", job="b"),
+    ]
+    _, problems = validate_lines(lines)
+    assert any("job 'a' wave index 1" in p for p in problems)
+
+
+def test_validate_lines_flags_missing_job_summary():
+    lines = [_ev("manifest", job="a"), _ev("wave", wave=1, job="a")]
+    _, problems = validate_lines(lines)
+    assert any("1 manifest(s) but 0" in p for p in problems)
+
+
+def test_validate_lines_flags_bad_job_tag():
+    _, problems = validate_lines([_ev("manifest", job="")])
+    assert any("non-empty string" in p for p in problems)

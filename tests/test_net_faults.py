@@ -1,0 +1,160 @@
+"""Opt-in network-fault actions (Raft.tla:508-523, --net-faults).
+
+DuplicateMessage re-delivers a record already in the bag DOMAIN;
+DropMessage discards one delivery. The TLA+ duplicate is unbounded (the
+disjuncts are commented out of Next at Raft.tla:540-541 for that
+reason); the lowering gates it on count < max_msg_copies, a documented
+divergence, so the fault-injected state space stays finite and these
+tests can insist on host/device count parity.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.checker.bfs import BFSChecker
+from raft_tpu.checker.device_bfs import DeviceBFS
+from raft_tpu.models.raft import EMPTY, RaftModel, RaftParams
+
+PARAMS = RaftParams(
+    n_servers=2, n_values=1, max_elections=1, max_restarts=0,
+    msg_slots=12, net_faults=True,
+)
+BASE = RaftParams(
+    n_servers=2, n_values=1, max_elections=1, max_restarts=0, msg_slots=12,
+)
+INVS = ("LeaderHasAllAckedValues", "NoLogDivergence")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return RaftModel(PARAMS)
+
+
+def test_action_table_grows_by_two_ranks(model):
+    base = RaftModel(BASE)
+    assert model.ACTION_NAMES[: len(base.ACTION_NAMES)] == base.ACTION_NAMES
+    assert model.ACTION_NAMES[-2:] == ["DuplicateMessage", "DropMessage"]
+    assert model._r_dup == len(base.ACTION_NAMES)
+    assert model._r_drop == model._r_dup + 1
+    # one binding per slot per fault, appended after HandleMessage
+    assert model.A == base.A + 2 * PARAMS.msg_slots
+    tail = model.bindings[-2 * PARAMS.msg_slots:]
+    assert [b[0] for b in tail[: PARAMS.msg_slots]] == (
+        ["DuplicateMessage"] * PARAMS.msg_slots
+    )
+    assert [b[0] for b in tail[PARAMS.msg_slots:]] == (
+        ["DropMessage"] * PARAMS.msg_slots
+    )
+
+
+def _state_with_message(model):
+    """Expand from Init until some successor holds a single-count
+    record; return (state vector, slot index)."""
+    states = model.init_states()
+    for _ in range(3):
+        succs, valid, _, _ = map(np.asarray, model.expand(states))
+        flat = succs[valid]
+        cnt = model.layout.get(flat, "msg_cnt")
+        hi = model.layout.get(flat, "msg_hi")
+        hits = np.argwhere((cnt == 1) & (hi != EMPTY))
+        if hits.size:
+            b, m = hits[0]
+            return flat[b], int(m)
+        states = flat
+    raise AssertionError("no reachable state with a pending message")
+
+
+def test_duplicate_bounded_by_max_msg_copies(model):
+    s, m = _state_with_message(model)
+    assert PARAMS.max_msg_copies == 2
+    valid, succ, rank, ovf = model._duplicate_message(s, m)
+    assert bool(valid) and int(rank) == model._r_dup and not bool(ovf)
+    succ = np.asarray(succ)
+    assert int(model.layout.get(succ, "msg_cnt")[m]) == 2
+    # only the count moved — the record payload is untouched
+    assert np.array_equal(
+        model.layout.get(succ, "msg_hi"), model.layout.get(s, "msg_hi")
+    )
+    # a second duplicate of the same record exceeds the copy bound
+    valid2, _, _, _ = model._duplicate_message(succ, m)
+    assert not bool(valid2)
+
+
+def test_drop_discards_one_delivery(model):
+    s, m = _state_with_message(model)
+    dup = np.asarray(model._duplicate_message(s, m)[1])
+    valid, succ, rank, _ = model._drop_message(dup, m)
+    assert bool(valid) and int(rank) == model._r_drop
+    assert int(model.layout.get(np.asarray(succ), "msg_cnt")[m]) == 1
+    # dropping the single original empties the delivery count
+    valid1, succ1, _, _ = model._drop_message(s, m)
+    assert bool(valid1)
+    assert int(model.layout.get(np.asarray(succ1), "msg_cnt")[m]) == 0
+
+
+def test_faults_invalid_on_empty_slot(model):
+    s = model.init_states()[0]  # Init has an empty bag
+    for m in range(PARAMS.msg_slots):
+        assert not bool(model._duplicate_message(s, m)[0])
+        assert not bool(model._drop_message(s, m)[0])
+
+
+def test_net_faults_fire_and_cover(model):
+    """Tier-1 smoke: a shallow fault-injected run reports the two new
+    coverage rows and both fault actions actually fire."""
+    res = BFSChecker(model, invariants=INVS, symmetry=True, chunk=256).run(
+        max_depth=3
+    )
+    assert res.violation is None
+    assert len(res.coverage) == len(model.ACTION_NAMES)
+    assert res.coverage[model._r_dup][1] > 0, "DuplicateMessage never fired"
+    assert res.coverage[model._r_drop][1] > 0, "DropMessage never fired"
+
+
+@pytest.mark.slow
+def test_net_faults_host_device_parity_and_coverage(model):
+    """Fault-injected spaces are where Duplicate interleavings bite:
+    the two engines must agree state for state, and the coverage table
+    must show both fault actions actually firing."""
+    depth = 4
+    host = BFSChecker(model, invariants=INVS, symmetry=True, chunk=256).run(
+        max_depth=depth
+    )
+    dev = DeviceBFS(
+        model, invariants=INVS, symmetry=True, chunk=256,
+        frontier_cap=1 << 13, seen_cap=1 << 16, journal_cap=1 << 16,
+    ).run(max_depth=depth)
+    assert host.violation is None and dev.violation is None
+    assert dev.distinct == host.distinct
+    assert dev.total == host.total
+    assert dev.depth_counts == host.depth_counts
+    assert dev.terminal == host.terminal
+    for cov in (host.coverage, dev.coverage):
+        assert len(cov) == len(model.ACTION_NAMES)
+        assert cov[model._r_dup][1] > 0, "DuplicateMessage never fired"
+        assert cov[model._r_drop][1] > 0, "DropMessage never fired"
+    assert host.coverage == dev.coverage
+    # faults strictly enlarge the space vs the same constants without
+    base = BFSChecker(
+        RaftModel(BASE), invariants=INVS, symmetry=True, chunk=256
+    ).run(max_depth=depth)
+    assert host.distinct > base.distinct
+
+
+def test_net_faults_registry_gate():
+    from raft_tpu.models.registry import CfgError, build_from_cfg
+    from raft_tpu.utils.cfg import Cfg, ModelValue
+
+    consts = {
+        "Server": (ModelValue("s1"), ModelValue("s2")),
+        "Value": (ModelValue("v1"),),
+        "MaxElections": 1,
+        "MaxRestarts": 0,
+    }
+    cfg = Cfg(path="t.cfg", constants=consts, symmetry=None,
+              invariants=["NoLogDivergence"], model_values=["s1", "s2", "v1"])
+    setup = build_from_cfg(cfg, spec="Raft", msg_slots=12, net_faults=True)
+    assert setup.model.p.net_faults
+    assert setup.model.ACTION_NAMES[-2:] == ["DuplicateMessage", "DropMessage"]
+    with pytest.raises(CfgError, match="only lowered for the Raft family"):
+        build_from_cfg(cfg, spec="PullRaft", msg_slots=12, net_faults=True)
