@@ -15,7 +15,8 @@ Exit codes (stable contract, pinned by tests/test_resilience.py):
     4   preempted (SIGTERM/SIGINT): a resumable checkpoint was written
         at the next wave boundary; re-run with --resume to continue
     5   unrecoverable failure (retry budget spent, capacity overflow
-        with no growth policy or no checkpoint, all generations corrupt)
+        with no growth policy or no checkpoint, all generations corrupt,
+        shard lost / shard stalled without --supervise)
     64  usage/config error (bad flags, bad cfg, checkpoint spec mismatch)
     66  input file not found (cfg or --resume path)
     70  fingerprint-collision audit failed
@@ -99,7 +100,25 @@ def main(argv=None):
         "start), transient=WAVE (injected device flake), ovf=WAVE "
         "(spurious frontier-overflow bit), truncate=NTH (tear the Nth "
         "checkpoint write), preempt=WAVE (SIGTERM self-delivery), "
-        "seed=S; each fault fires once",
+        "shard_loss=WAVE (kill one shard of the sharded mesh mid-wave; "
+        "the lost shard is seed mod D), seed=S; each fault fires once",
+    )
+    ap.add_argument(
+        "--no-reshard",
+        action="store_true",
+        help="refuse to resume a sharded checkpoint written on a "
+        "different mesh size (default: re-route the shards by fp mod D "
+        "on load — checkpoints are mesh-portable)",
+    )
+    ap.add_argument(
+        "--stall-abort",
+        type=float,
+        default=None,
+        metavar="FACTOR",
+        help="sharded checker: abort a wave that runs longer than FACTOR "
+        "times the rolling-median wave time, spilling a wave-start "
+        "checkpoint and raising a shard-stall (recoverable under "
+        "--supervise); needs at least 3 completed waves to calibrate",
     )
     ap.add_argument("--max-frontier-cap", type=int, default=None,
                     help="frontier growth bound (tpu checker)")
@@ -472,13 +491,18 @@ def main(argv=None):
             devs = devs[: args.devices]
 
         def make_checker(overrides):
+            # the supervisor's shard-loss recovery passes a shrunk
+            # "devices" override (the survivors); pop it out of the
+            # capacity-override dict so it lands on the keyword
+            ov = dict(overrides)
+            devs_ = ov.pop("devices", devs)
             return ShardedBFS(
                 setup.model,
                 invariants=setup.invariants,
                 symmetry=symmetry,
-                devices=devs,
+                devices=devs_,
                 chunk=args.chunk,
-                **{**cli_caps, **overrides},
+                **{**cli_caps, **ov},
             )
     elif args.checker == "tpu":
         from .checker.device_bfs import DeviceBFS
@@ -515,7 +539,11 @@ def main(argv=None):
 
         try:
             gen, ck_depth = rckpt.validate_resume(
-                args.resume, checker._ckpt_ident(), keep=args.checkpoint_keep)
+                args.resume, checker._ckpt_ident(), keep=args.checkpoint_keep,
+                allow_reshard=(
+                    args.checker == "sharded" and not args.no_reshard
+                ),
+            )
         except FileNotFoundError as e:
             print(f"error: --resume: {e}", file=sys.stderr)
             return 66
@@ -571,6 +599,8 @@ def main(argv=None):
         CapacityOverflow,
         CheckpointCorrupt,
         CheckpointMismatch,
+        ShardLost,
+        ShardStall,
         UnrecoverableError,
     )
 
@@ -585,6 +615,10 @@ def main(argv=None):
         checkpoint_keep=args.checkpoint_keep,
         resume=args.resume,
     )
+    if args.checker == "sharded":
+        run_kw["reshard"] = not args.no_reshard
+        if args.stall_abort is not None:
+            run_kw["stall_abort_factor"] = args.stall_abort
     if chaos_spec is not None:
         # ONE injector for the whole session: each fault fires once even
         # across supervisor attempts (a crash-at-wave-3 must not re-fire
@@ -613,6 +647,22 @@ def main(argv=None):
         return _finish(64)
     except (CheckpointCorrupt, UnrecoverableError) as e:
         print(f"error: {e}", file=sys.stderr)
+        return _finish(5)
+    except (ShardLost, ShardStall) as e:
+        print(f"error: {e}", file=sys.stderr)
+        if getattr(e, "checkpoint_saved", False):
+            print(
+                f"hint: a wave-start checkpoint was spilled to "
+                f"{args.checkpoint}; re-run with --supervise to shrink "
+                "the mesh onto the survivors and resume automatically",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "hint: re-run with --supervise and --checkpoint PATH to "
+                "recover shard failures automatically",
+                file=sys.stderr,
+            )
         return _finish(5)
     except CapacityOverflow as e:
         print(f"error: {e}", file=sys.stderr)

@@ -125,6 +125,8 @@ class BFSChecker:
         chunk: int = 1024,
         check_deadlock: bool = False,
     ):
+        # constructor kwargs, for _rebuild (supervisor growth overrides)
+        self._ctor_kw = {k: v for k, v in locals().items() if k != "self"}
         self.model = model
         self.invariants = tuple(invariants)
         self.chunk = chunk
@@ -964,6 +966,11 @@ class BFSChecker:
         the empty override dict (rebuild identically, resume); only the
         msg-slots bit — model shape, not engine capacity — is fatal."""
         return None if int(bits) & 1 else {}
+
+    def _rebuild(self, overrides: dict) -> "BFSChecker":
+        """A fresh engine with this one's constructor kwargs plus
+        ``overrides`` (the supervisor's growth dicts)."""
+        return type(self)(**{**self._ctor_kw, **overrides})
 
     def _save_checkpoint(
         self, path, frontier, seen, distinct, total, terminal, depth,

@@ -119,6 +119,8 @@ class DeviceBFS:
         fingerprint_seed: int = 0,
         canon_memo_cap: int = 1 << 21,
     ):
+        # constructor kwargs, for _rebuild (supervisor growth overrides)
+        self._ctor_kw = {k: v for k, v in locals().items() if k != "self"}
         self.model = model
         self.invariants = tuple(invariants)
         self.chunk = chunk
@@ -673,6 +675,11 @@ class DeviceBFS:
             g["max_seen_cap"] = self.MAX_SCAP * 4
         return g
 
+    def _rebuild(self, overrides: dict) -> "DeviceBFS":
+        """A fresh engine with this one's constructor kwargs plus
+        ``overrides`` (the supervisor's growth dicts)."""
+        return type(self)(**{**self._ctor_kw, **overrides})
+
     # ---------------- host driver ----------------
 
     def run(
@@ -1130,6 +1137,9 @@ class DeviceBFS:
         checkpoint_keep: int = rckpt.DEFAULT_KEEP,
         resume: bool = False,
         skip: tuple[str, ...] = (),
+        supervise: int | None = None,
+        chaos_by_job: dict | None = None,
+        recovery_stats: dict | None = None,
         **run_kw,
     ) -> list:
         """Fleet queue arm: run a fleet-bound model's jobs one at a time
@@ -1139,11 +1149,21 @@ class DeviceBFS:
         jit-cache hit (one precompile per layout group). Telemetry is
         job-tagged into one multiplexed stream (obs.JobTaggedTelemetry);
         each job checkpoints to its OWN lineage file under
-        ``checkpoint_dir`` (resilience/ckpt.py generations), so the
-        supervisor restarts / resumes only the failed job. Jobs named in
-        ``skip`` (fleet-level resume) yield None in the result list."""
+        ``checkpoint_dir`` (resilience/ckpt.py generations, named by
+        ``resilience.lineage_name`` so sanitizer collisions between job
+        names cannot alias two lineages), so the supervisor restarts /
+        resumes only the failed job. Jobs named in ``skip`` (fleet-level
+        resume) yield None in the result list.
+
+        ``supervise``: when set, each job runs under the resilience
+        supervisor with that per-job recovery budget; empty-override
+        recoveries reuse this instance's compiled programs (zero
+        recompiles), and a job whose budget is spent contributes its
+        terminal exception to the results list instead of killing the
+        fleet. ``chaos_by_job`` maps job name -> ChaosInjector for that
+        job only; ``recovery_stats`` is filled in place with job name ->
+        recovery count."""
         import os
-        import re as _re
 
         from ..obs.collector import JobTaggedTelemetry
 
@@ -1166,18 +1186,51 @@ class DeviceBFS:
                 kw = dict(run_kw)
                 if telemetry is not None:
                     kw["telemetry"] = JobTaggedTelemetry(telemetry, name)
+                if chaos_by_job and name in chaos_by_job:
+                    kw["chaos"] = chaos_by_job[name]
                 if checkpoint_dir is not None:
-                    safe = _re.sub(r"[^A-Za-z0-9._=-]", "_", name)
-                    ck = os.path.join(checkpoint_dir, f"{safe}.ckpt.npz")
+                    ck = os.path.join(
+                        checkpoint_dir, rckpt.lineage_name(name, j))
                     kw.setdefault("checkpoint_path", ck)
                     kw.setdefault("checkpoint_every_s", checkpoint_every_s)
                     kw.setdefault("checkpoint_keep", checkpoint_keep)
                     if resume and os.path.exists(ck):
                         kw.setdefault("resume", ck)
-                results.append(self.run(**kw))
+                if supervise is None:
+                    results.append(self.run(**kw))
+                    continue
+                results.append(self._run_supervised(
+                    kw, int(supervise), j, name, recovery_stats))
         finally:
             model.fleet_select(None)
         return results
+
+    def _run_supervised(self, kw, budget, job_index, name, recovery_stats):
+        """One fleet job under the resilience supervisor. Returns the
+        run result, or the terminal exception object when the job's
+        recovery budget is spent (the fleet driver maps it to an
+        ``unrecoverable`` JobResult)."""
+        from ..resilience import (
+            CheckpointMismatch,
+            UnrecoverableError,
+            supervise as _supervise,
+        )
+
+        def factory(overrides):
+            return self if not overrides else self._rebuild(overrides)
+
+        stats: dict = {}
+        try:
+            res = _supervise(
+                factory, kw, max_retries=budget, backoff_base=0.0,
+                seed=job_index, telemetry=kw.get("telemetry"),
+                stats_out=stats,
+            )
+        except (UnrecoverableError, CheckpointMismatch) as exc:
+            res = exc
+        if recovery_stats is not None:
+            recovery_stats[name] = int(stats.get("recoveries", 0))
+        return res
 
     def _coverage_fields(self, depth, cov_h, scount, depth_counts) -> dict:
         """Dedup-structure gauges + the per-action block for a coverage

@@ -54,6 +54,19 @@ def sweep_main(argv=None) -> int:
         "resume per-job checkpoints where they exist (packed host "
         "groups rerun wholly unless every member finished)",
     )
+    ap.add_argument(
+        "--supervise",
+        nargs="?",
+        const=5,
+        default=None,
+        type=int,
+        metavar="N",
+        help="run each job under the resilience supervisor with a "
+        "per-job budget of N recoveries (default 5); a job that spends "
+        "its budget becomes an rc-5 unrecoverable result without "
+        "killing the rest of the sweep, and per-job recovery counts "
+        "land in fleet_state.json and each job summary",
+    )
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="one multiplexed JSONL telemetry stream; every "
                     "event carries a 'job' field")
@@ -107,6 +120,7 @@ def sweep_main(argv=None) -> int:
         state_dir=args.state_dir,
         resume=args.resume,
         verbose=args.verbose,
+        supervise=args.supervise,
     )
 
     from ..utils.cfg import CfgError
@@ -136,6 +150,8 @@ def sweep_main(argv=None) -> int:
                     bits.append(f"VIOLATED={j.violation['invariant']}")
                 if j.exit_cause:
                     bits.append(f"exit={j.exit_cause}")
+                if j.recoveries:
+                    bits.append(f"recoveries={j.recoveries}")
             else:
                 bits += [f"behaviors={j.behaviors}", f"steps={j.steps}"]
                 if j.violation:

@@ -18,6 +18,18 @@ records each completed job's rc after every group/job; on resume,
 completed jobs are skipped — except packed host groups, which rerun
 WHOLLY unless every member is done (the co-resident frontier has no
 per-job restart point; per-job device lineages do).
+
+Supervised sweeps (``SweepOptions.supervise`` / ``fleet --supervise``):
+each serial/packed-device job runs under the resilience supervisor with
+that per-job recovery budget. Recoveries that need neither growth nor a
+mesh change reuse the group's compiled engine (zero recompiles), a job
+whose budget is spent becomes an rc-5 ``unrecoverable`` JobResult
+without killing the rest of the sweep, and per-job recovery counts land
+in ``fleet_state.json`` (``recoveries``) and each JobResult. Per-job
+fault injection comes from the manifest's ``chaos`` field (one
+ChaosInjector per job, shared across its retries); packed HOST groups
+ignore chaos/supervision — the co-resident frontier has no per-job
+recovery point.
 """
 
 from __future__ import annotations
@@ -25,12 +37,19 @@ from __future__ import annotations
 import fnmatch
 import json
 import os
-import re
 import time
 from dataclasses import dataclass
 
 from ..checker.bfs import BFSChecker
 from ..obs import JobTaggedTelemetry
+from ..resilience import (
+    ChaosInjector,
+    ChaosSpec,
+    CheckpointMismatch,
+    UnrecoverableError,
+    lineage_name,
+    supervise as _supervise,
+)
 from .grouping import FleetGroup, group_jobs
 from .manifest import FleetJob, FleetManifest, ManifestError
 from .packer import build_packed
@@ -49,10 +68,7 @@ class SweepOptions:
     state_dir: str | None = None  # checkpoints + fleet_state.json
     resume: bool = False
     verbose: bool = False
-
-
-def _safe(name: str) -> str:
-    return re.sub(r"[^A-Za-z0-9._=-]", "_", name)
+    supervise: int | None = None  # per-job recovery budget (None: off)
 
 
 def _state_path(state_dir: str) -> str:
@@ -69,15 +85,35 @@ def _load_completed(opts: SweepOptions) -> dict[str, int]:
         return {str(k): int(v) for k, v in json.load(fh)["completed"].items()}
 
 
-def _save_completed(opts: SweepOptions, completed: dict[str, int]) -> None:
+def _save_completed(opts: SweepOptions, completed: dict[str, int],
+                    recoveries: dict[str, int] | None = None) -> None:
     if not opts.state_dir:
         return
     os.makedirs(opts.state_dir, exist_ok=True)
     path = _state_path(opts.state_dir)
     tmp = path + ".tmp"
+    state: dict = {"completed": completed}
+    if recoveries:
+        state["recoveries"] = recoveries
     with open(tmp, "w") as fh:
-        json.dump({"completed": completed}, fh)
+        json.dump(state, fh)
     os.replace(tmp, path)
+
+
+def _job_chaos(job: FleetJob) -> ChaosInjector | None:
+    """One injector per job per sweep — shared across the job's
+    supervisor retries so a consumed fault never re-fires."""
+    if not job.chaos:
+        return None
+    return ChaosInjector(ChaosSpec.parse(job.chaos))
+
+
+def _unrecoverable(name: str, exc: BaseException,
+                   recoveries: int) -> JobResult:
+    return JobResult(
+        name=name, mode="check", rc=rc_for("unrecoverable", None),
+        seconds=0.0, exit_cause="unrecoverable", recoveries=recoveries,
+    )
 
 
 def _skipped(job: FleetJob, rc: int) -> JobResult:
@@ -183,10 +219,11 @@ def _run_simulate_group(group, opts, completed, out) -> int:
     return 1 if ran else 0
 
 
-def _run_serial_group(group, opts, completed, out, telemetry) -> int:
+def _run_serial_group(group, opts, completed, out, telemetry,
+                      recoveries) -> int:
     model = group.setups[0].model  # identical params -> one jit cache
     ran = 0
-    for job, setup in zip(group.jobs, group.setups):
+    for idx, (job, setup) in enumerate(zip(group.jobs, group.setups)):
         if opts.resume and job.name in completed:
             out[job.name] = _skipped(job, completed[job.name])
             continue
@@ -198,22 +235,45 @@ def _run_serial_group(group, opts, completed, out, telemetry) -> int:
         )
         if telemetry is not None:
             kw["telemetry"] = JobTaggedTelemetry(telemetry, job.name)
+        chaos = _job_chaos(job)
+        if chaos is not None:
+            kw["chaos"] = chaos
         if opts.state_dir:
             ck = os.path.join(
-                opts.state_dir, "ckpt", f"{_safe(job.name)}.ckpt.npz"
+                opts.state_dir, "ckpt", lineage_name(job.name, idx)
             )
             os.makedirs(os.path.dirname(ck), exist_ok=True)
             kw["checkpoint_path"] = ck
             if opts.resume and os.path.exists(ck):
                 kw["resume"] = ck
-        out[job.name] = _check_result(job.name, eng.run(**kw))
+        if opts.supervise is None:
+            out[job.name] = _check_result(job.name, eng.run(**kw))
+        else:
+            stats: dict = {}
+
+            def factory(ov, _eng=eng):
+                return _eng if not ov else _eng._rebuild(ov)
+
+            try:
+                r = _supervise(
+                    factory, kw, max_retries=int(opts.supervise),
+                    backoff_base=0.0, seed=idx,
+                    telemetry=kw.get("telemetry"), stats_out=stats,
+                )
+                out[job.name] = _check_result(job.name, r)
+            except (UnrecoverableError, CheckpointMismatch) as exc:
+                out[job.name] = _unrecoverable(
+                    job.name, exc, int(stats.get("recoveries", 0)))
+            out[job.name].recoveries = int(stats.get("recoveries", 0))
+            recoveries[job.name] = out[job.name].recoveries
         ran += 1
         completed[job.name] = out[job.name].rc
-        _save_completed(opts, completed)
+        _save_completed(opts, completed, recoveries)
     return 1 if ran else 0
 
 
-def _run_packed_group(group, opts, completed, out, telemetry) -> int:
+def _run_packed_group(group, opts, completed, out, telemetry,
+                      recoveries) -> int:
     names = [j.name for j in group.jobs]
     if opts.resume and all(n in completed for n in names):
         for job in group.jobs:
@@ -224,7 +284,8 @@ def _run_packed_group(group, opts, completed, out, telemetry) -> int:
     eng = _make_engine(opts.engine, model, setup, opts)
     if opts.engine == "host":
         # co-resident arm: one shared frontier; no per-job restart
-        # point, so a partially-completed group reruns wholly
+        # point, so a partially-completed group reruns wholly (and
+        # chaos/supervision don't apply — there is no per-job recovery)
         results = eng.run_fleet(
             job_names=names,
             max_depth=opts.max_depth,
@@ -240,6 +301,17 @@ def _run_packed_group(group, opts, completed, out, telemetry) -> int:
         if opts.state_dir:
             ckpt_dir = os.path.join(opts.state_dir, "ckpt")
             os.makedirs(ckpt_dir, exist_ok=True)
+        chaos_by_job = {
+            j.name: inj for j in group.jobs
+            if (inj := _job_chaos(j)) is not None
+        }
+        rstats: dict[str, int] = {}
+        fleet_kw: dict = {}
+        if chaos_by_job:
+            fleet_kw["chaos_by_job"] = chaos_by_job
+        if opts.supervise is not None:
+            fleet_kw["supervise"] = int(opts.supervise)
+            fleet_kw["recovery_stats"] = rstats
         results = eng.run_fleet(
             job_names=names,
             telemetry=telemetry,
@@ -249,16 +321,22 @@ def _run_packed_group(group, opts, completed, out, telemetry) -> int:
             max_depth=opts.max_depth,
             verbose=opts.verbose,
             time_budget_s=opts.time_budget_s,
+            **fleet_kw,
         )
         for job, r in zip(group.jobs, results):
-            out[job.name] = (
-                _skipped(job, completed[job.name])
-                if r is None
-                else _check_result(job.name, r)
-            )
+            if r is None:
+                out[job.name] = _skipped(job, completed[job.name])
+            elif isinstance(r, BaseException):
+                out[job.name] = _unrecoverable(
+                    job.name, r, rstats.get(job.name, 0))
+            else:
+                out[job.name] = _check_result(job.name, r)
+            if job.name in rstats:
+                out[job.name].recoveries = rstats[job.name]
+                recoveries[job.name] = rstats[job.name]
     for name in names:
         completed[name] = out[name].rc
-    _save_completed(opts, completed)
+    _save_completed(opts, completed, recoveries)
     return 1
 
 
@@ -286,6 +364,7 @@ def run_sweep(
     groups = group_jobs(mf)
     completed = _load_completed(opts)
     out: dict[str, JobResult] = {}
+    recoveries: dict[str, int] = {}
     precompiles = 0
     t0 = time.perf_counter()
     for gi, group in enumerate(groups):
@@ -298,11 +377,11 @@ def run_sweep(
             precompiles += _run_simulate_group(group, opts, completed, out)
         elif group.kind == "serial":
             precompiles += _run_serial_group(
-                group, opts, completed, out, telemetry
+                group, opts, completed, out, telemetry, recoveries
             )
         else:
             precompiles += _run_packed_group(
-                group, opts, completed, out, telemetry
+                group, opts, completed, out, telemetry, recoveries
             )
     return FleetResult(
         jobs=[out[j.name] for j in mf.jobs],

@@ -12,6 +12,8 @@ A manifest is one JSON object describing a sweep:
         "msg_slots":  24,                  // default: spec builder default
         "mode":       "check",             // or "simulate"
         "net_faults": false,               // Raft family only
+        "chaos": "crash=2,seed=7",         // per-job fault injection
+                                           // (resilience.ChaosSpec grammar)
         "sim": {"walks": 128, "max_behavior_depth": 50, "seed": 0,
                 "max_behaviors": null, "max_steps": 100000}  // -simulate knobs
       },
@@ -68,6 +70,7 @@ class FleetJob:
     msg_slots: int | None = None
     mode: str = "check"
     net_faults: bool = False
+    chaos: str | None = None  # validated ChaosSpec grammar, or None
     sim: dict = field(default_factory=lambda: dict(SIM_DEFAULTS))
 
 
@@ -133,6 +136,16 @@ def _job_from(obj: dict, defaults: dict, spec: str, path: str,
     unknown = set(sim) - set(SIM_DEFAULTS)
     if unknown:
         raise ManifestError(f"{path}: unknown sim keys {sorted(unknown)}")
+    chaos = obj.get("chaos", defaults.get("chaos"))
+    if chaos is not None:
+        if not isinstance(chaos, str):
+            raise ManifestError(f"{path}: chaos must be a spec string")
+        from ..resilience import ChaosSpec
+
+        try:
+            ChaosSpec.parse(chaos)
+        except ValueError as e:
+            raise ManifestError(f"{path}: {e}") from e
     job_name = obj.get("name", name)
     if not job_name:
         raise ManifestError(f"{path}: explicit jobs need a name")
@@ -145,6 +158,7 @@ def _job_from(obj: dict, defaults: dict, spec: str, path: str,
         msg_slots=msg_slots,
         mode=mode,
         net_faults=bool(obj.get("net_faults", defaults.get("net_faults", False))),
+        chaos=chaos,
         sim=sim,
     )
 

@@ -39,6 +39,7 @@ class JobResult:
     behaviors: int | None = None
     steps: int | None = None
     skipped: bool = False  # already completed in a resumed sweep
+    recoveries: int | None = None  # supervised sweeps: per-job recoveries
 
     def to_json(self) -> dict:
         out = {
@@ -52,7 +53,7 @@ class JobResult:
         if self.exit_cause is not None:
             out["exit_cause"] = self.exit_cause
         for k in ("distinct", "total", "depth", "terminal", "trace_len",
-                  "behaviors", "steps"):
+                  "behaviors", "steps", "recoveries"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

@@ -142,6 +142,33 @@ PREEMPT_KEYS = (
     "event", "signame", "depth", "checkpoint",
 )
 
+# elastic-mesh events (sharded engine + supervisor):
+#   shard_lost   a shard's device died mid-wave (chaos shard_loss=K or a
+#                real preemption observed by the engine): which shard of
+#                how many, the wave in flight, and whether a
+#                redistributable wave-start checkpoint was spilled.
+#                Emitted before the engine raises ShardLost — so it must
+#                come before the run's summary.
+#   reshard      a resume re-routed a checkpoint written on a different
+#                mesh size by fp mod D_new. Emitted right after the
+#                resumed run's manifest, before any wave.
+#   shard_stall  the per-shard stall watchdog aborted a pathologically
+#                slow wave instead of hanging the all-to-all: the
+#                suspect (most-loaded) shard, the wave's seconds vs the
+#                rolling median, and the configured factor. Emitted
+#                before the engine raises ShardStall.
+SHARD_LOST_KEYS = (
+    "event", "wave", "depth", "shard", "device_count", "checkpoint_saved",
+)
+
+RESHARD_KEYS = (
+    "event", "path", "from_d", "to_d", "depth", "distinct",
+)
+
+SHARD_STALL_KEYS = (
+    "event", "wave", "depth", "shard", "wave_s", "median_wave_s", "factor",
+)
+
 DECLARED_EVENTS = (
     ("manifest", MANIFEST_KEYS),
     ("wave", WAVE_KEYS),
@@ -152,6 +179,9 @@ DECLARED_EVENTS = (
     ("resume", RESUME_KEYS),
     ("ckpt_generation", CKPT_GENERATION_KEYS),
     ("preempt", PREEMPT_KEYS),
+    ("shard_lost", SHARD_LOST_KEYS),
+    ("reshard", RESHARD_KEYS),
+    ("shard_stall", SHARD_STALL_KEYS),
 )
 
 EVENT_KEYS = dict(DECLARED_EVENTS)
@@ -245,6 +275,38 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
                     f"{where}ckpt_generation skipped must be a list of "
                     f"diagnostic strings"
                 )
+    if etype in ("shard_lost", "shard_stall"):
+        shard = ev.get("shard")
+        if isinstance(shard, bool) or not isinstance(shard, int) or shard < 0:
+            problems.append(
+                f"{where}{etype} shard {shard!r} must be an int >= 0"
+            )
+        if etype == "shard_lost":
+            dc = ev.get("device_count")
+            if isinstance(dc, bool) or not isinstance(dc, int) or dc < 1:
+                problems.append(
+                    f"{where}shard_lost device_count {dc!r} must be an "
+                    f"int >= 1"
+                )
+            elif isinstance(shard, int) and not isinstance(shard, bool) \
+                    and not 0 <= shard < dc:
+                problems.append(
+                    f"{where}shard_lost shard {shard} out of range for "
+                    f"device_count {dc}"
+                )
+    if etype == "reshard":
+        for key in ("from_d", "to_d"):
+            d = ev.get(key)
+            if isinstance(d, bool) or not isinstance(d, int) or d < 1:
+                problems.append(
+                    f"{where}reshard {key} {d!r} must be an int >= 1"
+                )
+        fd, td = ev.get("from_d"), ev.get("to_d")
+        if isinstance(fd, int) and isinstance(td, int) and fd == td:
+            problems.append(
+                f"{where}reshard from_d == to_d == {fd} (a same-size "
+                f"resume must not emit a reshard event)"
+            )
     if etype == "coverage":
         acts = ev.get("actions")
         if not isinstance(acts, list) or any(
@@ -278,6 +340,14 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     attempts must be strictly increasing across a supervised session (a
     summary ends the session and resets the counter — a completed run
     means any later retry belongs to a new invocation).
+
+    Elastic-mesh rules: a ``reshard`` event belongs to the load phase —
+    it must come after its run's manifest but before the first wave and
+    never after the summary; ``shard_lost``/``shard_stall`` abort an
+    in-flight wave, so they must come before the run's summary and
+    carry a wave index no smaller than the last completed wave (a new
+    manifest resets these expectations too, which is the per-job reset
+    in a multiplexed fleet stream).
 
     Job-tagged streams (fleet sweeps) add: per-job wave indices must be
     strictly increasing within that job's run (its ``job``-tagged
@@ -367,6 +437,28 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
                     )
                 else:
                     job_wave[job] = w
+        elif etype == "reshard":
+            if summarized:
+                problems.append(
+                    f"line {lineno}: reshard event after the run's summary"
+                )
+            elif last_wave > 0:
+                problems.append(
+                    f"line {lineno}: reshard event after wave {last_wave} "
+                    f"(resharding happens at load time, before any wave)"
+                )
+        elif etype in ("shard_lost", "shard_stall"):
+            if summarized:
+                problems.append(
+                    f"line {lineno}: {etype} event after the run's summary"
+                )
+            w = ev.get("wave")
+            if isinstance(w, int) and not isinstance(w, bool) \
+                    and w < last_wave:
+                problems.append(
+                    f"line {lineno}: {etype} wave index {w} behind the "
+                    f"run's last completed wave {last_wave}"
+                )
         elif etype == "retry":
             att = ev.get("attempt")
             if isinstance(att, int) and not isinstance(att, bool):

@@ -31,7 +31,19 @@ the parent shard is implicit in the all-to-all block structure (received
 rows [d*RC:(d+1)*RC] came from chip d) and is never routed.
 
 Checkpoint/resume (round-4 verdict Next #3): same .npz scheme as
-DeviceBFS with per-shard arrays; a resume must use the same mesh size.
+DeviceBFS with per-shard arrays — but the payload is MESH-PORTABLE
+(elastic-mesh PR): every per-shard array is a segment routable by
+``fp mod D`` (the journal carries each row's fingerprint in ``jfp``
+exactly for this), the recorded ``/D=<n>/`` ident component is
+provenance rather than identity, and a load-time reshard pass
+(``_reshard_payload``) re-routes every segment when the resuming mesh
+size differs — D=8 -> D=4 -> D=1 all resume with bit-identical counts.
+Pre-``jfp`` checkpoints reshard too: ``_recover_journal_fps`` rebuilds
+the journal fingerprints by topological replay through the model's
+transition function. On capacity overflow or shard loss the abort path
+spills a WAVE-START checkpoint by subtracting the aborted wave's
+fingerprints back out of the LSM export (``_wave_start_seen``), so
+supervised recoveries lose zero work — matching DeviceBFS semantics.
 
 State counts are exact and deterministic; within-wave discovery ORDER
 differs from the sequential driver (first-occurrence tie-breaking is by
@@ -72,7 +84,7 @@ from ..ops.hashing import (
 )
 from ..ops.symmetry import Canonicalizer
 from ..resilience import ckpt as rckpt
-from ..resilience.errors import CapacityOverflow
+from ..resilience.errors import CapacityOverflow, ShardLost, ShardStall
 
 AXIS = "shards"
 
@@ -145,6 +157,10 @@ class ShardedBFS:
         max_journal_cap: int = 1 << 24,
         canon_memo_cap: int = 1 << 21,
     ):
+        # constructor kwargs, captured before any normalization, so the
+        # supervisor/fleet can rebuild this engine with overrides
+        # (grown caps, a shrunk device list after a shard loss)
+        self._ctor_kw = {k: v for k, v in locals().items() if k != "self"}
         self.model = model
         self.invariants = tuple(invariants)
         # rank-indexed coverage rows; 0 for models without the
@@ -252,25 +268,30 @@ class ShardedBFS:
                 _shard_map(
                     self._chunk_step,
                     mesh=self.mesh,
-                    in_specs=(spec,) * 10 + (P(), P(), spec) + (spec,) * n_runs,
-                    out_specs=(spec,) * 9,
+                    in_specs=(spec,) * 11 + (P(), P(), spec) + (spec,) * n_runs,
+                    out_specs=(spec,) * 10,
                     **_SHARD_MAP_KW,
                 ),
-                # donated: next_buf, jps, jpl, jcand, viol, stats, memo, cov
-                donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9),
+                # donated: next_buf, jps, jpl, jcand, jfp, viol, stats,
+                # memo, cov
+                donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10),
             )
             self._chunk_fn_cache[n_runs] = fn
         return fn
 
     def _chunk_step(
-        self, frontier, fcount, next_buf, jps, jpl, jcand, viol, stats,
+        self, frontier, fcount, next_buf, jps, jpl, jcand, jfp, viol, stats,
         memo, cov, cursor, occ, base_lgid, *runs,
     ):
         """One chunk of the current wave on one chip.
 
         frontier [1,F+EPAD,W]; fcount/base_lgid [1,1]; next_buf
         [1,F+EPAD,W]; jps/jpl/jcand [1,JC+EPAD] (the EPAD=D*RC tail rows
-        are the emit drop region); viol [1,K]; occ bool[L] (replicated);
+        are the emit drop region); jfp [1,JC+EPAD] u64 — each journal
+        row's canonical fingerprint, the lane that makes the checkpoint
+        mesh-portable (reshard routes rows by jfp mod D_new) and the
+        wave-start LSM subtraction exact; viol [1,K]; occ bool[L]
+        (replicated);
         runs: L sharded [1,lanes] sorted u64; memo [1,MCAP,2] shard-local
         canon memo; cov [1,n_actions,3] i64 per-shard cumulative
         [enabled, fired, new] per action rank (enabled/fired tally on the
@@ -287,6 +308,7 @@ class ShardedBFS:
         frontier, fcount, base_lgid = frontier[0], fcount[0, 0], base_lgid[0, 0]
         next_buf = next_buf[0]
         jps, jpl, jcand, viol, stats = jps[0], jpl[0], jcand[0], viol[0], stats[0]
+        jfp = jfp[0]
         memo = memo[0]
         cov = cov[0]
         runs = [r[0] for r in runs]
@@ -435,10 +457,14 @@ class ShardedBFS:
         jc_blk = jnp.concatenate(
             [recv_pay[sidx, W + 1], jnp.zeros((1,), jnp.int32)]
         )[esel]
+        jfp_blk = jnp.concatenate(
+            [rf, jnp.full((1,), U64_MAX, jnp.uint64)]
+        )[esel]
         next_buf, frontier_ovf = emit_append(next_buf, blk, ncount, n_new, F)
         jps, journal_ovf = emit_append(jps, jps_blk, jcount, n_new, JC)
         jpl, _ = emit_append(jpl, jpl_blk, jcount, n_new, JC)
         jcand, _ = emit_append(jcand, jc_blk, jcount, n_new, JC)
+        jfp, _ = emit_append(jfp, jfp_blk, jcount, n_new, JC)
         if K:
             # new-distinct per rank on the owner chip (non-new lanes ->
             # drop bucket K; their routed rank column may be garbage 0s
@@ -483,8 +509,8 @@ class ShardedBFS:
             ]
         )
         return (
-            next_buf[None], jps[None], jpl[None], jcand[None], viol[None],
-            stats[None], memo[None], cov[None], new_run[None],
+            next_buf[None], jps[None], jpl[None], jcand[None], jfp[None],
+            viol[None], stats[None], memo[None], cov[None], new_run[None],
         )
 
     # ---------------- capacity growth (between waves, host-mediated) ------
@@ -517,6 +543,8 @@ class ShardedBFS:
                             self.MAX_JCAP, self.GROWTH, 1)
             for key in ("jps", "jpl", "jcand"):
                 repad(key, new + self.EPAD, self.JCAP + self.EPAD, 0)
+            repad("jfp", new + self.EPAD, self.JCAP + self.EPAD,
+                  np.uint64(U64_MAX))
             self.JCAP = new
         return state
 
@@ -545,6 +573,22 @@ class ShardedBFS:
             growth["max_seen_cap"] = self.MAX_SCAP * 4
         return growth or None
 
+    def survivors_for_shard_loss(self, shard: int) -> dict | None:
+        """Constructor-kwarg overrides that rebuild this engine on the
+        mesh minus the lost shard's device, or None when there is no
+        surviving mesh (D == 1). The supervisor pairs this with a
+        reshard-on-resume of the newest checkpoint."""
+        devs = list(self.mesh.devices.flat)
+        if len(devs) <= 1:
+            return None
+        devs.pop(int(shard) % len(devs))
+        return {"devices": devs}
+
+    def _rebuild(self, overrides: dict) -> "ShardedBFS":
+        """A fresh engine with this one's constructor kwargs plus
+        ``overrides`` (the supervisor's growth / shrunk-mesh dicts)."""
+        return type(self)(**{**self._ctor_kw, **overrides})
+
     # ---------------- checkpoint ----------------
 
     def _ckpt_ident(self) -> str:
@@ -552,6 +596,9 @@ class ShardedBFS:
         # canonical representative of signature-tied states; the
         # refinement depth is part of the fingerprint formula. The canon
         # memo is value-preserving and not part of the identity.
+        # /D=<n>/ is PROVENANCE, not identity: resilience/ckpt.check_spec
+        # strips it (mesh_neutral) when deciding reshardability, and the
+        # resume path re-routes the payload when it differs.
         wl = getattr(self.canon, "refine_rounds", 1)
         return (
             f"sharded/{self.model.name}/{self.model.p}/W={self.W}"
@@ -562,11 +609,12 @@ class ShardedBFS:
     def _save_checkpoint(
         self, path, state, fcounts, scounts, jcounts, n0, base_lgid,
         distinct, total, terminal, depth, gen_prev, routed_prev, depth_counts,
-        coverage,
+        coverage, seen_override=None,
     ):
-        import os
-
-        seen = self._lsm_export()
+        # seen_override: wave-start per-shard fingerprints computed by
+        # _wave_start_seen when the LSM is contaminated by an aborted
+        # wave (overflow / shard-loss abort paths)
+        seen = self._lsm_export() if seen_override is None else seen_override
         assert [len(s) for s in seen] == [int(x) for x in scounts], (
             "LSM export does not match per-shard scounts"
         )
@@ -582,7 +630,10 @@ class ShardedBFS:
         rckpt.save_npz(
             path,
             dict(
-                version=1,
+                # payload layout v2: + jfp (per-row journal fingerprints,
+                # the mesh-portability lane). v1 payloads still load —
+                # _recover_journal_fps rebuilds jfp by replay.
+                version=2,
                 spec=self._ckpt_ident(),
                 fcounts=fcounts, scounts=scounts, jcounts=jcounts,
                 n0=n0, base_lgid=base_lgid,
@@ -591,6 +642,7 @@ class ShardedBFS:
                 jps=np.asarray(jax.device_get(state["jps"]))[:, :jmax],
                 jpl=np.asarray(jax.device_get(state["jpl"]))[:, :jmax],
                 jcand=np.asarray(jax.device_get(state["jcand"]))[:, :jmax],
+                jfp=np.asarray(jax.device_get(state["jfp"]))[:, :jmax],
                 init_by_shard_flat=np.concatenate(
                     [np.stack(s) if s else np.zeros((0, self.W), np.int32)
                      for s in self._init_by_shard], axis=0),
@@ -606,6 +658,348 @@ class ShardedBFS:
             chaos=getattr(self, "_chaos", None),
         )
 
+    # ------------- mesh portability (reshard / recovery) -------------
+
+    def _wave_start_seen(self, state, stats_h, jcounts, scounts, ovf_bits):
+        """Per-shard wave-start seen fingerprints at an abort point, or
+        None when they cannot be reconstructed.
+
+        The chunk loop inserts each chunk's new fingerprints into the
+        LSM as it goes, so by the time an abort fires the seen-set is
+        contaminated with the (partial) aborted wave. But the SAME
+        chunk programs journalled those fingerprints into the jfp lane:
+        rows [jcounts[d], stats_h[d,1]) are exactly the wave's inserts,
+        so subtracting them from the LSM export recovers the wave-start
+        set bit-exactly. Fallback chain when lanes overflowed:
+
+          journal intact (bit 16 clear) -> jfp slice (exact);
+          journal full but frontier intact (bit 8 clear) -> refingerprint
+            next_buf rows [0, stats_h[d,0]) (the same states, undropped);
+          both overflowed -> None (some inserted fps are unrecorded).
+
+        Every reconstruction is length-verified against the wave-start
+        scounts before use — a mismatch returns None rather than an
+        unsound checkpoint.
+        """
+        D = self.D
+        stats_h = np.asarray(stats_h)
+        lsm = self._lsm_export()  # wave-start seen + aborted wave's inserts
+        if not (ovf_bits & 16):
+            jfp_h = np.asarray(jax.device_get(state["jfp"]))
+            wave = [
+                jfp_h[d, int(jcounts[d]): int(stats_h[d, 1])].astype(np.uint64)
+                for d in range(D)
+            ]
+        elif not (ovf_bits & 8):
+            nb = np.asarray(jax.device_get(state["next_buf"]))
+            wave = []
+            for d in range(D):
+                rows = nb[d, : int(stats_h[d, 0])]
+                wave.append(
+                    np.asarray(
+                        jax.device_get(self.canon.fingerprints(rows)),
+                        dtype=np.uint64,
+                    )
+                    if len(rows)
+                    else np.zeros(0, np.uint64)
+                )
+        else:
+            return None
+        out = []
+        for d in range(D):
+            ws = np.setdiff1d(lsm[d], wave[d])
+            if len(ws) != int(scounts[d]):
+                return None
+            out.append(ws)
+        return out
+
+    def _abort_wave_start(
+        self, checkpoint_path, state, stats_h, fcounts, scounts, jcounts,
+        n0, base_lgid, distinct, total, terminal, depth, gen_prev,
+        routed_prev, depth_counts, cov_hd,
+    ):
+        """Spill a wave-start checkpoint at an abort point (overflow,
+        shard loss, stall). All counters passed in are the HOST wave-
+        start values — the journal/jfp tails the aborted wave appended
+        are sliced off by _save_checkpoint's jmax, and the seen-set is
+        rebuilt by _wave_start_seen. Returns True when a checkpoint was
+        written (False: no path routed, or the wave is unreconstructable
+        because both the journal and frontier lanes overflowed)."""
+        if checkpoint_path is None:
+            return False
+        stats_h = np.asarray(stats_h)
+        ovf_bits = int(np.bitwise_or.reduce(stats_h[:, 4]))
+        ws = self._wave_start_seen(state, stats_h, jcounts, scounts, ovf_bits)
+        if ws is None:
+            return False
+        self._save_checkpoint(
+            checkpoint_path, state, fcounts, scounts, jcounts, n0,
+            base_lgid, distinct, total, terminal, depth, gen_prev,
+            routed_prev, depth_counts, cov_hd, seen_override=ws,
+        )
+        return True
+
+    def _recover_journal_fps(self, ck, d_ck) -> np.ndarray:
+        """Rebuild the jfp lane of a pre-v2 (payload ``version=1``)
+        checkpoint by topological replay.
+
+        v1 payloads journalled (parent shard, parent lgid, cand) per row
+        but not the row's own fingerprint. Every row's STATE is
+        recomputable: replay the journalled candidate action on the
+        parent state. Rows resolve in rounds — a row is ready once its
+        parent is an init state (known immediately) or an already-
+        resolved journal row — and each round batches all ready parents
+        through one vmapped expansion + fingerprint call. Cost is one
+        expansion per journalled state, paid once: the resumed run
+        checkpoints in v2 format, so the upgrade never repeats.
+        """
+        model, W = self.model, self.W
+        jcounts = np.asarray(ck["jcounts"], np.int64)
+        n0 = np.asarray(ck["n0"], np.int64)
+        jmax = int(jcounts.max()) if len(jcounts) else 0
+        jfp = np.full((d_ck, jmax), np.uint64(U64_MAX))
+        if jmax == 0:
+            return jfp
+        jps = np.asarray(ck["jps"])
+        jpl = np.asarray(ck["jpl"])
+        jcand = np.asarray(ck["jcand"])
+        counts = np.asarray(ck["init_by_shard_count"], np.int64)
+        flat = np.asarray(ck["init_by_shard_flat"])
+        ioff = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        states = np.zeros((d_ck, jmax, W), np.int32)
+        known = np.zeros((d_ck, jmax), bool)
+        pending = [
+            (d, j) for d in range(d_ck) for j in range(int(jcounts[d]))
+        ]
+        expand1 = jax.jit(jax.vmap(model._expand1))
+        CH = 4096  # fixed batch: one compile, garbage-padded tail
+        while pending:
+            ready: list[tuple[int, int]] = []
+            parents: list[np.ndarray] = []
+            rest: list[tuple[int, int]] = []
+            for d, j in pending:
+                pd, pl = int(jps[d, j]), int(jpl[d, j])
+                if pl < n0[pd]:
+                    parents.append(flat[ioff[pd] + pl])
+                elif known[pd, pl - n0[pd]]:
+                    parents.append(states[pd, pl - n0[pd]])
+                else:
+                    rest.append((d, j))
+                    continue
+                ready.append((d, j))
+            assert ready, "journal replay stuck: unresolvable parent row"
+            batch = np.stack(parents).astype(np.int32)
+            children = np.empty((len(ready), W), np.int32)
+            for s in range(0, len(ready), CH):
+                blk = batch[s: s + CH]
+                pad = CH - len(blk)
+                if pad:
+                    blk = np.concatenate(
+                        [blk, np.repeat(blk[:1], pad, axis=0)], axis=0)
+                succs, _valid, _rank, _ovf = jax.device_get(expand1(blk))
+                for i, (d, j) in enumerate(ready[s: s + CH]):
+                    children[s + i] = succs[i, int(jcand[d, j])]
+            fps = np.asarray(
+                jax.device_get(self.canon.fingerprints(children)),
+                dtype=np.uint64,
+            )
+            for i, (d, j) in enumerate(ready):
+                states[d, j] = children[i]
+                jfp[d, j] = fps[i]
+                known[d, j] = True
+            pending = rest
+        return jfp
+
+    def _reshard_payload(self, ck: dict, d_old: int) -> dict:
+        """Re-route a mesh-portable checkpoint written on a D=``d_old``
+        mesh onto this engine's D=``self.D`` mesh.
+
+        Every persisted structure is a per-shard partition of one global
+        set, keyed by fingerprint: seen fps and init states re-route by
+        ``fp mod D_new`` directly; journal rows route by their jfp, kept
+        in stable (old shard, old row) order per new shard EXCEPT that
+        frontier rows (the last fcounts[d] rows of each old shard) are
+        ordered LAST per new shard — preserving the engine invariant
+        that frontier row i of shard d is journal row
+        ``jcounts[d]-fcounts[d]+i``. Parent pointers rewrite through the
+        old->new (shard, lgid) maps. Per-shard coverage counters sum
+        into shard 0 (only fleet totals are ever reported). The result
+        resumes with counts bit-identical to the same run on the
+        original mesh.
+        """
+        D_new, W = self.D, self.W
+        fcounts_o = np.asarray(ck["fcounts"], np.int64)
+        scounts_o = np.asarray(ck["scounts"], np.int64)
+        jcounts_o = np.asarray(ck["jcounts"], np.int64)
+        frontier_o = np.asarray(ck["frontier"])
+        seen_o = np.asarray(ck["seen"])
+        jps_o, jpl_o = np.asarray(ck["jps"]), np.asarray(ck["jpl"])
+        jcand_o = np.asarray(ck["jcand"])
+        jfp_o = np.asarray(ck["jfp"], np.uint64)
+        counts_o = np.asarray(ck["init_by_shard_count"], np.int64)
+        flat = np.asarray(ck["init_by_shard_flat"]).astype(np.int32)
+        n0_o = np.asarray(ck["n0"], np.int64)
+
+        # --- inits: route by fingerprint, stable flat order per shard
+        n_init = len(flat)
+        if n_init:
+            ifp = np.asarray(
+                jax.device_get(self.canon.fingerprints(flat)), np.uint64)
+        else:
+            ifp = np.zeros(0, np.uint64)
+        iowner = (ifp % np.uint64(D_new)).astype(np.int64)
+        ioff_o = np.concatenate([[0], np.cumsum(counts_o)]).astype(np.int64)
+        n0_n = np.bincount(iowner, minlength=D_new).astype(np.int64)
+        iord = np.argsort(iowner, kind="stable")
+        new_il = np.empty(n_init, np.int64)
+        new_il[iord] = np.concatenate(
+            [np.arange(int(c)) for c in n0_n]
+        ) if n_init else np.zeros(0, np.int64)
+        init_by_shard_n: list[list[np.ndarray]] = [[] for _ in range(D_new)]
+        for idx in iord:
+            init_by_shard_n[int(iowner[idx])].append(np.asarray(flat[idx]))
+
+        # --- journal rows: flatten, route by jfp, frontier rows last
+        nrows = int(jcounts_o.sum())
+        glob_d = np.repeat(np.arange(d_old), jcounts_o)
+        glob_j = (
+            np.concatenate([np.arange(int(c)) for c in jcounts_o])
+            if nrows else np.zeros(0, np.int64)
+        ).astype(np.int64)
+        joff_o = np.concatenate([[0], np.cumsum(jcounts_o)]).astype(np.int64)
+        jfp_flat = (
+            np.concatenate(
+                [jfp_o[d, : int(jcounts_o[d])] for d in range(d_old)])
+            if nrows else np.zeros(0, np.uint64)
+        )
+        jowner = (jfp_flat % np.uint64(D_new)).astype(np.int64)
+        front0 = jcounts_o - fcounts_o  # first frontier journal row, per shard
+        is_front = glob_j >= front0[glob_d]
+        order = np.lexsort((glob_j, glob_d, is_front, jowner))
+        jcounts_n = np.bincount(jowner, minlength=D_new).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(jcounts_n)]).astype(np.int64)
+        # old flat row -> new (shard, row); `order` is grouped by owner
+        new_jd = np.repeat(np.arange(D_new), jcounts_n)
+        new_jj = (
+            np.concatenate([np.arange(int(c)) for c in jcounts_n])
+            if nrows else np.zeros(0, np.int64)
+        ).astype(np.int64)
+        jd_of = np.empty(nrows, np.int64)
+        jj_of = np.empty(nrows, np.int64)
+        jd_of[order] = new_jd
+        jj_of[order] = new_jj
+
+        # --- parent pointer rewrite through the old->new maps
+        pd = (
+            np.concatenate(
+                [jps_o[d, : int(jcounts_o[d])] for d in range(d_old)])
+            if nrows else np.zeros(0, np.int64)
+        ).astype(np.int64)
+        pl = (
+            np.concatenate(
+                [jpl_o[d, : int(jcounts_o[d])] for d in range(d_old)])
+            if nrows else np.zeros(0, np.int64)
+        ).astype(np.int64)
+        cand_flat = (
+            np.concatenate(
+                [jcand_o[d, : int(jcounts_o[d])] for d in range(d_old)])
+            if nrows else np.zeros(0, np.int64)
+        )
+        isin = pl < n0_o[pd]
+        rew_pd = np.empty(nrows, np.int64)
+        rew_pl = np.empty(nrows, np.int64)
+        fi = ioff_o[pd[isin]] + pl[isin]
+        rew_pd[isin] = iowner[fi]
+        rew_pl[isin] = new_il[fi]
+        fj = joff_o[pd[~isin]] + (pl[~isin] - n0_o[pd[~isin]])
+        rew_pd[~isin] = jd_of[fj]
+        rew_pl[~isin] = n0_n[jd_of[fj]] + jj_of[fj]
+
+        jmax_n = int(jcounts_n.max()) if nrows else 0
+        jps_n = np.zeros((D_new, jmax_n), np.int32)
+        jpl_n = np.zeros((D_new, jmax_n), np.int32)
+        jcand_n = np.zeros((D_new, jmax_n), np.int32)
+        jfp_n = np.full((D_new, jmax_n), np.uint64(U64_MAX))
+        rew_pd_s, rew_pl_s = rew_pd[order], rew_pl[order]
+        cand_s, fp_s = cand_flat[order], jfp_flat[order]
+        for d in range(D_new):
+            s, c = int(starts[d]), int(jcounts_n[d])
+            jps_n[d, :c] = rew_pd_s[s: s + c]
+            jpl_n[d, :c] = rew_pl_s[s: s + c]
+            jcand_n[d, :c] = cand_s[s: s + c]
+            jfp_n[d, :c] = fp_s[s: s + c]
+
+        # --- frontier: journal-tail rows in new-journal order (or the
+        # inits themselves when no wave has committed yet)
+        if nrows:
+            isf_s = is_front[order]
+            gd_s, gj_s = glob_d[order], glob_j[order]
+            fcounts_n = np.bincount(
+                jowner[is_front], minlength=D_new).astype(np.int64)
+            fmax_n = max(1, int(fcounts_n.max()))
+            frontier_n = np.zeros((D_new, fmax_n, W), np.int32)
+            fpos = np.zeros(D_new, np.int64)
+            for k in range(nrows):
+                if not isf_s[k]:
+                    continue
+                d = int(new_jd[k])
+                frontier_n[d, fpos[d]] = frontier_o[
+                    gd_s[k], int(gj_s[k] - front0[gd_s[k]])]
+                fpos[d] += 1
+        else:
+            fcounts_n = n0_n.copy()
+            fmax_n = max(1, int(fcounts_n.max()))
+            frontier_n = np.zeros((D_new, fmax_n, W), np.int32)
+            for d in range(D_new):
+                for i, st in enumerate(init_by_shard_n[d]):
+                    frontier_n[d, i] = st
+
+        # --- seen: repartition + sort per new shard
+        seen_parts: list[list[np.ndarray]] = [[] for _ in range(D_new)]
+        for d in range(d_old):
+            s = seen_o[d, : int(scounts_o[d])].astype(np.uint64)
+            own = (s % np.uint64(D_new)).astype(np.int64)
+            for dn in range(D_new):
+                seen_parts[dn].append(s[own == dn])
+        seen_n = [
+            np.sort(np.concatenate(p)) if p else np.zeros(0, np.uint64)
+            for p in seen_parts
+        ]
+        scounts_n = np.asarray([len(s) for s in seen_n], np.int64)
+        assert (scounts_n == n0_n + jcounts_n).all(), (
+            "reshard broke the seen = inits + journal invariant"
+        )
+        smax_n = max(1, int(scounts_n.max()))
+        seen_h = np.full((D_new, smax_n), np.uint64(U64_MAX))
+        for d, s in enumerate(seen_n):
+            seen_h[d, : len(s)] = s
+
+        cov_o = (
+            np.asarray(ck["coverage"], np.int64)
+            if "coverage" in ck
+            else np.zeros((d_old, self.n_actions, 3), np.int64)
+        )
+        cov_n = np.zeros((D_new, self.n_actions, 3), np.int64)
+        if self.n_actions:
+            cov_n[0] = cov_o.sum(axis=0)
+
+        out = dict(ck)
+        out.update(
+            version=np.int64(2),
+            spec=self._ckpt_ident(),
+            fcounts=fcounts_n, scounts=scounts_n, jcounts=jcounts_n,
+            n0=n0_n, base_lgid=n0_n + jcounts_n - fcounts_n,
+            frontier=frontier_n, seen=seen_h,
+            jps=jps_n, jpl=jpl_n, jcand=jcand_n, jfp=jfp_n,
+            init_by_shard_flat=np.concatenate(
+                [np.stack(s) if s else np.zeros((0, W), np.int32)
+                 for s in init_by_shard_n], axis=0),
+            init_by_shard_count=np.asarray(
+                [len(s) for s in init_by_shard_n], np.int64),
+            coverage=cov_n,
+        )
+        return out
+
     # ---------------- host driver ----------------
 
     def run(
@@ -618,6 +1012,8 @@ class ShardedBFS:
         checkpoint_every_s: float = 300.0,
         checkpoint_keep: int = rckpt.DEFAULT_KEEP,
         resume: str | None = None,
+        reshard: bool = True,
+        stall_abort_factor: float | None = None,
         telemetry=None,
         preempt=None,
         chaos=None,
@@ -650,11 +1046,22 @@ class ShardedBFS:
 
         ck_gen = 0
         ck_skipped: list[str] = []
+        reshard_from: int | None = None
         if resume is not None:
             ck, ck_gen, ck_skipped = rckpt.load_npz(
                 resume, keep=checkpoint_keep)
             ident = self._ckpt_ident()
-            rckpt.check_spec(ck, ident, resume)
+            rckpt.check_spec(ck, ident, resume, allow_reshard=reshard)
+            d_ck = rckpt.mesh_d_of(str(ck["spec"])) or D
+            if "jfp" not in ck:
+                # pre-v2 payload: rebuild the fingerprint lane once by
+                # replay — the resumed run saves in v2, so this upgrade
+                # cost is paid a single time per lineage
+                ck = dict(ck)
+                ck["jfp"] = self._recover_journal_fps(ck, d_ck)
+            if d_ck != D:
+                ck = self._reshard_payload(ck, d_ck)
+                reshard_from = d_ck
             fcounts = np.asarray(ck["fcounts"], np.int64)
             scounts = np.asarray(ck["scounts"], np.int64)
             jcounts = np.asarray(ck["jcounts"], np.int64)
@@ -671,6 +1078,8 @@ class ShardedBFS:
                   ("jps", "jpl", "jcand")}
             for k in jh:
                 jh[k][:, :jmax] = ck[k]
+            jfp_h = np.full((D, self.JCAP + self.EPAD), np.uint64(U64_MAX))
+            jfp_h[:, :jmax] = np.asarray(ck["jfp"], np.uint64)[:, :jmax]
             seen_h = np.asarray(ck["seen"])
             self._lsm_seed(
                 [seen_h[d, : scounts[d]] for d in range(D)]
@@ -711,6 +1120,7 @@ class ShardedBFS:
                 "jps": jax.device_put(jh["jps"], self._sharding),
                 "jpl": jax.device_put(jh["jpl"], self._sharding),
                 "jcand": jax.device_put(jh["jcand"], self._sharding),
+                "jfp": jax.device_put(jfp_h, self._sharding),
                 "viol": jax.device_put(
                     np.full((D, max(1, len(self.invariants))), I32_MAX,
                             np.int32), self._sharding),
@@ -755,6 +1165,9 @@ class ShardedBFS:
                 "jcand": jax.device_put(
                     np.zeros((D, self.JCAP + self.EPAD), np.int32),
                     self._sharding),
+                "jfp": jax.device_put(
+                    np.full((D, self.JCAP + self.EPAD), np.uint64(U64_MAX)),
+                    self._sharding),
                 "viol": jax.device_put(
                     np.full((D, max(1, len(self.invariants))), I32_MAX, np.int32),
                     self._sharding),
@@ -779,6 +1192,10 @@ class ShardedBFS:
             tel.event(
                 "resume", path=resume, generation=ck_gen, depth=depth,
                 distinct=distinct)
+            if reshard_from is not None:
+                tel.event(
+                    "reshard", path=resume, from_d=reshard_from, to_d=D,
+                    depth=depth, distinct=distinct)
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
         # fresh per-shard memo per run: a pure cache, but starting empty
@@ -787,6 +1204,7 @@ class ShardedBFS:
         state["cov"] = jax.device_put(cov_hd, self._sharding)
         memo_prev = 0
         per_shard_memo = np.zeros(D, np.int64)
+        wave_times: list[float] = []  # stall-watchdog rolling window
 
         while fcounts.sum() and violation is None:
             if preempt is not None and preempt.requested:
@@ -839,17 +1257,46 @@ class ShardedBFS:
                     occ_dev = self._occ_dev()
                     chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
                     (state["next_buf"], state["jps"], state["jpl"],
-                     state["jcand"], state["viol"], state["stats"],
-                     state["memo"], state["cov"], new_run,
+                     state["jcand"], state["jfp"], state["viol"],
+                     state["stats"], state["memo"], state["cov"], new_run,
                      ) = chunk_fn(
                         state["frontier"], fc_dev, state["next_buf"],
                         state["jps"], state["jpl"], state["jcand"],
-                        state["viol"], state["stats"], state["memo"],
-                        state["cov"], np.int32(cursor), occ_dev, bl_dev,
-                        *self._lsm.runs,
+                        state["jfp"], state["viol"], state["stats"],
+                        state["memo"], state["cov"], np.int32(cursor),
+                        occ_dev, bl_dev, *self._lsm.runs,
                     )
                     self._lsm.insert(new_run)
                     chunks_done += 1
+                    if chaos is not None:
+                        lost = chaos.shard_loss(depth + 1, D)
+                        if lost is not None:
+                            # deterministic stand-in for a device dying
+                            # mid-wave: spill a wave-start checkpoint
+                            # (jfp subtraction — mid-wave the LSM holds
+                            # only the chunks already inserted, and the
+                            # jfp lane recorded exactly those), classify,
+                            # and let the supervisor reshard onto the
+                            # survivors
+                            stats_mid = np.asarray(
+                                jax.device_get(state["stats"]))
+                            saved = self._abort_wave_start(
+                                checkpoint_path, state, stats_mid,
+                                fcounts, scounts, jcounts, n0, base_lgid,
+                                distinct, total, terminal + term_base,
+                                depth, gen_prev + gen_base,
+                                routed_prev + routed_base, depth_counts,
+                                cov_hd,
+                            )
+                            tel.event(
+                                "shard_lost", wave=depth + 1, depth=depth,
+                                shard=int(lost), device_count=D,
+                                checkpoint_saved=bool(saved))
+                            raise ShardLost(
+                                f"shard {lost} lost its device mid-wave "
+                                f"{depth + 1} (chaos)",
+                                shard=int(lost), checkpoint_saved=saved,
+                            )
                 # cov rides the same once-per-wave fetch — no extra
                 # device_get calls with coverage on
                 stats_h, viol_h, cov_w = jax.device_get(
@@ -861,22 +1308,61 @@ class ShardedBFS:
             if chaos is not None:
                 ovf_bits = chaos.ovf_bits(ovf_bits, depth + 1, 8)
             if ovf_bits:
-                # unlike DeviceBFS, no wave-start checkpoint can be
-                # written here: the chunk loop already inserted this
-                # wave's fingerprints into the LSM, so an export would
-                # not match the wave-start scounts. The supervisor
-                # resumes from the last periodic checkpoint (or fresh
-                # with grown caps) — both are sound, just re-explore.
+                # the chunk loop already inserted this wave's fps into
+                # the LSM, but the jfp lane journalled exactly what was
+                # inserted — _abort_wave_start subtracts the aborted
+                # wave back out and spills a wave-start checkpoint, so
+                # a grown resume loses zero work (parity with DeviceBFS)
+                stats_abort = stats_h.copy()
+                stats_abort[:, 4] = ovf_bits  # incl. chaos-injected bits
+                saved = self._abort_wave_start(
+                    checkpoint_path, state, stats_abort, fcounts, scounts,
+                    jcounts, n0, base_lgid, distinct, total,
+                    terminal + term_base, depth, gen_prev + gen_base,
+                    routed_prev + routed_base, depth_counts, cov_hd,
+                )
                 raise CapacityOverflow(
                     f"sharded BFS capacity overflow (bits={ovf_bits:05b}: "
                     "1=msg-slots 2=valid_per_state/valid_per_group "
-                    "4=route_cap 8=frontier_cap 16=journal_cap)",
+                    "4=route_cap 8=frontier_cap 16=journal_cap)"
+                    + (f"; wave-start checkpoint saved to {checkpoint_path}"
+                       if saved else ""),
                     what=tuple(
                         name for bit, name in self.OVF_NAMES
                         if ovf_bits & bit),
                     bits=ovf_bits,
-                    checkpoint_saved=False,
+                    checkpoint_saved=saved,
                 )
+            # per-shard stall watchdog: a wave pathologically slower than
+            # the rolling median flags a sick device (thermal throttle,
+            # ICI link flap) — classify instead of hanging the fleet. The
+            # ovf check above already passed, so the jfp lane holds the
+            # whole wave and the wave-start spill is exact.
+            wave_s_now = time.perf_counter() - tw
+            if stall_abort_factor is not None and len(wave_times) >= 3:
+                med = float(np.median(wave_times[-16:]))
+                if med > 0 and wave_s_now > stall_abort_factor * med:
+                    suspect = int(np.argmax(new_d))  # most-loaded shard
+                    saved = self._abort_wave_start(
+                        checkpoint_path, state, stats_h, fcounts, scounts,
+                        jcounts, n0, base_lgid, distinct, total,
+                        terminal + term_base, depth, gen_prev + gen_base,
+                        routed_prev + routed_base, depth_counts, cov_hd,
+                    )
+                    tel.event(
+                        "shard_stall", wave=depth + 1, depth=depth,
+                        shard=suspect, wave_s=round(wave_s_now, 3),
+                        median_wave_s=round(med, 3),
+                        factor=round(wave_s_now / med, 3))
+                    raise ShardStall(
+                        f"wave {depth + 1} took {wave_s_now:.3f}s against "
+                        f"a rolling median of {med:.3f}s "
+                        f"(factor {wave_s_now / med:.1f} > "
+                        f"{stall_abort_factor}); suspect shard {suspect}",
+                        shard=suspect, wave_s=wave_s_now, median_s=med,
+                        checkpoint_saved=saved,
+                    )
+            wave_times.append(wave_s_now)
             # commit only after the ovf check: an aborted wave keeps the
             # wave-start counters (consistent with what a checkpoint saved)
             cov_hd = np.asarray(cov_w, dtype=np.int64)
@@ -1096,6 +1582,9 @@ class ShardedBFS:
         checkpoint_keep: int = rckpt.DEFAULT_KEEP,
         resume: bool = False,
         skip: tuple[str, ...] = (),
+        supervise: int | None = None,
+        chaos_by_job: dict | None = None,
+        recovery_stats: dict | None = None,
         **run_kw,
     ) -> list:
         """Fleet queue arm over all shards: same contract as
@@ -1103,9 +1592,20 @@ class ShardedBFS:
         instance (``fleet_select`` swaps only the stamped init states,
         so the sharded programs compile once per group), job-tagged
         telemetry, and one checkpoint lineage per job under
-        ``checkpoint_dir``."""
+        ``checkpoint_dir`` (named by ``resilience.lineage_name``, which
+        disambiguates sanitizer collisions with the job index).
+
+        ``supervise``: when set, each job runs under the resilience
+        supervisor with that per-job recovery budget; the engine factory
+        returns THIS instance for empty overrides, so recoveries that
+        need no growth/reshard reuse the compiled programs (zero
+        recompiles). A job whose budget is spent (or whose failure has
+        no recovery policy) contributes its UnrecoverableError /
+        CheckpointMismatch to the results list instead of killing the
+        rest of the fleet. ``chaos_by_job`` maps job name -> a
+        ChaosInjector for that job only. ``recovery_stats`` (a dict) is
+        filled in place with job name -> recovery count."""
         import os
-        import re as _re
 
         from ..obs.collector import JobTaggedTelemetry
 
@@ -1128,18 +1628,53 @@ class ShardedBFS:
                 kw = dict(run_kw)
                 if telemetry is not None:
                     kw["telemetry"] = JobTaggedTelemetry(telemetry, name)
+                if chaos_by_job and name in chaos_by_job:
+                    kw["chaos"] = chaos_by_job[name]
                 if checkpoint_dir is not None:
-                    safe = _re.sub(r"[^A-Za-z0-9._=-]", "_", name)
-                    ck = os.path.join(checkpoint_dir, f"{safe}.ckpt.npz")
+                    ck = os.path.join(
+                        checkpoint_dir, rckpt.lineage_name(name, j))
                     kw.setdefault("checkpoint_path", ck)
                     kw.setdefault("checkpoint_every_s", checkpoint_every_s)
                     kw.setdefault("checkpoint_keep", checkpoint_keep)
                     if resume and os.path.exists(ck):
                         kw.setdefault("resume", ck)
-                results.append(self.run(**kw))
+                if supervise is None:
+                    results.append(self.run(**kw))
+                    continue
+                results.append(self._run_supervised(
+                    kw, int(supervise), j, name, recovery_stats))
         finally:
             model.fleet_select(None)
         return results
+
+    def _run_supervised(self, kw, budget, job_index, name, recovery_stats):
+        """One fleet job under the resilience supervisor. Returns the
+        run result, or the terminal exception object when the job's
+        recovery budget is spent (the fleet driver maps it to an
+        ``unrecoverable`` JobResult)."""
+        from ..resilience import (
+            CheckpointMismatch,
+            UnrecoverableError,
+            supervise as _supervise,
+        )
+
+        def factory(overrides):
+            # empty overrides -> the cached engine: recoveries that need
+            # neither growth nor a shrunk mesh stay recompile-free
+            return self if not overrides else self._rebuild(overrides)
+
+        stats: dict = {}
+        try:
+            res = _supervise(
+                factory, kw, max_retries=budget, backoff_base=0.0,
+                seed=job_index, telemetry=kw.get("telemetry"),
+                stats_out=stats,
+            )
+        except (UnrecoverableError, CheckpointMismatch) as exc:
+            res = exc
+        if recovery_stats is not None:
+            recovery_stats[name] = int(stats.get("recoveries", 0))
+        return res
 
     def _coverage_fields(self, depth, cov_hd, scounts, depth_counts) -> dict:
         """Coverage-event payload (obs.events.COVERAGE_KEYS), fleet-summed

@@ -13,7 +13,10 @@ from .ckpt import (
     content_hash,
     format_version_of,
     generation_path,
+    lineage_name,
     load_npz,
+    mesh_d_of,
+    mesh_neutral,
     save_npz,
     validate_resume,
 )
@@ -24,6 +27,8 @@ from .errors import (
     CheckpointMismatch,
     InjectedCrash,
     InjectedTransient,
+    ShardLost,
+    ShardStall,
     UnrecoverableError,
     is_transient,
 )
@@ -43,6 +48,8 @@ __all__ = [
     "InjectedCrash",
     "InjectedTransient",
     "PreemptionGuard",
+    "ShardLost",
+    "ShardStall",
     "UnrecoverableError",
     "check_spec",
     "content_hash",
@@ -50,7 +57,10 @@ __all__ = [
     "generation_path",
     "has_checkpoint",
     "is_transient",
+    "lineage_name",
     "load_npz",
+    "mesh_d_of",
+    "mesh_neutral",
     "save_npz",
     "supervise",
     "validate_resume",

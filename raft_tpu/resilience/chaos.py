@@ -21,8 +21,14 @@ Spec grammar (comma-separated ``key=int`` pairs, each fault fires once):
   preempt=K    deliver a real SIGTERM to this process at the start of
                wave K, exercising the actual signal handler and the
                rc-4 checkpoint-at-wave-boundary path
-  seed=S       seeds the truncation cut point; recorded so a chaos run
-               is reproducible from its command line alone
+  shard_loss=K kill one shard's device mid-wave K (sharded engine only):
+               the engine spills a redistributable wave-start checkpoint
+               and raises ShardLost; the supervisor reshards onto the
+               surviving D-1 mesh. The doomed shard is seed % D so the
+               scenario replays from the command line alone.
+  seed=S       seeds the truncation cut point and the doomed shard;
+               recorded so a chaos run is reproducible from its command
+               line alone
 
 Hooks are called from engine wave loops (``wave_start``, ``ovf_bits``)
 and from ``ckpt.save_npz`` (``checkpoint_written``). One injector
@@ -39,19 +45,22 @@ import signal
 
 from .errors import InjectedCrash, InjectedTransient
 
-_KEYS = ("crash", "transient", "ovf", "truncate", "preempt", "seed")
+# "seed" must stay last: __str__ iterates _KEYS[:-1] for the fault keys
+_KEYS = ("crash", "transient", "ovf", "truncate", "preempt", "shard_loss",
+         "seed")
 
 
 class ChaosSpec:
     """Parsed, validated ``--chaos`` specification."""
 
     def __init__(self, crash=None, transient=None, ovf=None,
-                 truncate=None, preempt=None, seed=0):
+                 truncate=None, preempt=None, shard_loss=None, seed=0):
         self.crash = crash
         self.transient = transient
         self.ovf = ovf
         self.truncate = truncate
         self.preempt = preempt
+        self.shard_loss = shard_loss
         self.seed = int(seed)
 
     @classmethod
@@ -96,7 +105,7 @@ class ChaosInjector:
         self._rng = random.Random(spec.seed)
         self._pending = {
             k: getattr(spec, k)
-            for k in ("crash", "transient", "ovf", "preempt")
+            for k in ("crash", "transient", "ovf", "preempt", "shard_loss")
             if getattr(spec, k) is not None
         }
         self._writes_seen = 0
@@ -132,6 +141,16 @@ class ChaosInjector:
         if self._pending.get("ovf") == wave and self._consume("ovf"):
             return int(bits) | int(frontier_bit)
         return int(bits)
+
+    def shard_loss(self, wave: int, n_shards: int) -> int | None:
+        """Called from the sharded engine's chunk loop with the 1-based
+        wave in flight; returns the shard to kill (seed % n_shards, so
+        the scenario is reproducible from the spec alone) once at the
+        configured wave, None otherwise."""
+        if (self._pending.get("shard_loss") == wave
+                and self._consume("shard_loss")):
+            return self.spec.seed % max(1, int(n_shards))
+        return None
 
     def checkpoint_written(self, path: str) -> None:
         """Called by ckpt.save_npz after each successful publish; tears

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
 
 import numpy as np
 
@@ -136,9 +137,14 @@ def _read_verify(path: str) -> dict:
         raise CheckpointCorrupt(
             f"{path}: unreadable ({type(e).__name__}: {e})"
         ) from e
-    version = int(payload.get("version", payload.get("format_version", 1)))
-    if "format_version" in payload:
-        version = int(payload["format_version"])
+    # hash verification is keyed on the CONTAINER revision
+    # (format_version, stamped by save_npz) — not on the engines' own
+    # payload-layout "version" field, which revs independently (the
+    # sharded engine's mesh-portable layout is payload v2 but any
+    # container may carry it)
+    version = (
+        int(payload["format_version"]) if "format_version" in payload else 1
+    )
     if version >= 2:
         stored = str(payload.get(HASH_KEY, ""))
         if not stored:
@@ -192,12 +198,51 @@ def format_version_of(payload: dict) -> int:
     return int(payload.get("version", 1))
 
 
-def check_spec(payload: dict, expect_ident: str, path: str) -> None:
+# The sharded engine's ident embeds its mesh size as /D=<n>/ — that D
+# is PROVENANCE (which mesh wrote the file), not identity: the payload
+# is a set of per-shard sorted-fingerprint segments that reshard onto
+# any mesh by fp mod D_new. These helpers strip/extract it so check_spec
+# can tell "different model" from "same model, different mesh".
+_MESH_D_RE = re.compile(r"/D=(\d+)")
+
+
+def mesh_d_of(spec: str) -> int | None:
+    """Mesh size recorded in a checkpoint ident, or None when the ident
+    has no /D=<n>/ component (host and single-device engines)."""
+    m = _MESH_D_RE.search(spec)
+    return int(m.group(1)) if m else None
+
+
+def mesh_neutral(spec: str) -> str:
+    """The ident with its /D=<n> provenance component removed — two
+    specs with equal neutral forms differ only in mesh size."""
+    return _MESH_D_RE.sub("", spec)
+
+
+def lineage_name(name: str, index: int) -> str:
+    """Per-job checkpoint filename inside a fleet's checkpoint_dir.
+
+    Sanitizing alone is ambiguous — "a/b" and "a_b" both sanitize to
+    "a_b" — so the job's position in the fleet disambiguates the
+    lineage (job order is part of the packed layout, hence stable)."""
+    safe = re.sub(r"[^A-Za-z0-9._=-]", "_", name)
+    return f"{safe}.j{int(index)}.ckpt.npz"
+
+
+def check_spec(payload: dict, expect_ident: str, path: str,
+               allow_reshard: bool = False) -> None:
     """Refuse a checkpoint whose identity or format this build cannot
     soundly resume. The messages are load-bearing: the "checkpoint is
     for spec" prefix is a documented contract (tests match it), and a
     future format version must fail HERE with a clear sentence, not
-    later with a numpy KeyError."""
+    later with a numpy KeyError.
+
+    ``allow_reshard``: accept a checkpoint whose ident differs from
+    ``expect_ident`` ONLY in its /D=<n> mesh-size component — the
+    sharded engine re-routes the segments by fp mod D_new at load time.
+    When False, a pure mesh mismatch still fails, but with a message
+    naming both mesh sizes and the reshard path instead of the generic
+    spec mismatch."""
     version = format_version_of(payload)
     if version > FORMAT_VERSION:
         raise CheckpointMismatch(
@@ -205,18 +250,31 @@ def check_spec(payload: dict, expect_ident: str, path: str) -> None:
             f"build's v{FORMAT_VERSION}; upgrade raft_tpu to resume it"
         )
     spec = str(payload.get("spec", "<missing spec field>"))
-    if spec != expect_ident:
+    if spec == expect_ident:
+        return
+    d_ck, d_run = mesh_d_of(spec), mesh_d_of(expect_ident)
+    if (d_ck is not None and d_run is not None and d_ck != d_run
+            and mesh_neutral(spec) == mesh_neutral(expect_ident)):
+        if allow_reshard:
+            return
         raise CheckpointMismatch(
-            f"checkpoint is for spec {spec}, model is {expect_ident}"
+            f"{path}: checkpoint was written on a D={d_ck} mesh, this run "
+            f"is on D={d_run} — the payload is mesh-portable; drop "
+            f"--no-reshard to re-route the shards by fp mod {d_run} on "
+            f"resume"
         )
+    raise CheckpointMismatch(
+        f"checkpoint is for spec {spec}, model is {expect_ident}"
+    )
 
 
 def validate_resume(path: str, expect_ident: str,
-                    keep: int = DEFAULT_KEEP) -> tuple[int, int]:
+                    keep: int = DEFAULT_KEEP,
+                    allow_reshard: bool = False) -> tuple[int, int]:
     """Fail-fast --resume validation: prove the checkpoint exists, loads
     (falling back through generations), and matches the model identity —
     BEFORE the caller pays the multi-second precompile. Returns
     ``(generation, depth)`` of the checkpoint that will be used."""
     payload, gen, _skipped = load_npz(path, keep=keep)
-    check_spec(payload, expect_ident, path)
+    check_spec(payload, expect_ident, path, allow_reshard=allow_reshard)
     return gen, int(payload.get("depth", 0))

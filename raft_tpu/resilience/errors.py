@@ -66,6 +66,39 @@ class InjectedTransient(RuntimeError):
     supervisor retries with backoff WITHOUT rebuilding capacities."""
 
 
+class ShardLost(RuntimeError):
+    """One shard's device died mid-wave (real preemption or the chaos
+    harness's ``shard_loss=K`` stand-in). ``shard`` is the dead shard's
+    index on the mesh that observed the loss; ``checkpoint_saved`` is
+    True when the engine spilled a redistributable wave-start checkpoint
+    before raising — the supervisor reshards that checkpoint onto the
+    surviving D-1 mesh and continues."""
+
+    def __init__(self, message: str, shard: int = -1,
+                 checkpoint_saved: bool = False):
+        super().__init__(message)
+        self.shard = int(shard)
+        self.checkpoint_saved = bool(checkpoint_saved)
+
+
+class ShardStall(RuntimeError):
+    """The per-shard stall watchdog classified a wave as pathologically
+    slow (``wave_s`` > factor x the rolling-median wave time) and the
+    engine aborted at the wave boundary instead of hanging the
+    all-to-all. ``shard`` is the suspect (most-loaded) shard. The
+    supervisor treats this like a transient: backoff and resume from the
+    wave-start checkpoint (``checkpoint_saved``) or the newest periodic
+    generation."""
+
+    def __init__(self, message: str, shard: int = -1, wave_s: float = 0.0,
+                 median_s: float = 0.0, checkpoint_saved: bool = False):
+        super().__init__(message)
+        self.shard = int(shard)
+        self.wave_s = float(wave_s)
+        self.median_s = float(median_s)
+        self.checkpoint_saved = bool(checkpoint_saved)
+
+
 class UnrecoverableError(RuntimeError):
     """The supervisor exhausted its retry budget (or hit a failure with
     no recovery policy). Carries the last underlying failure as

@@ -14,6 +14,16 @@ policy layer TLC keeps in its outer loop:
   transient/crash    -> exponential backoff + seeded jitter, rebuild a
                         fresh engine, resume from the newest intact
                         checkpoint generation.
+  ShardLost          -> one shard's device died mid-wave: ask the
+                        engine for the surviving device list
+                        (``survivors_for_shard_loss``), rebuild on the
+                        D-1 mesh, resume from the wave-start checkpoint
+                        the engine spilled — the load-time reshard pass
+                        re-routes every segment by fp mod (D-1). A
+                        single-device mesh has no survivors: fatal.
+  ShardStall         -> the per-shard stall watchdog classified a wave
+                        as pathologically slow; treated like a
+                        transient (backoff + resume, same mesh).
   CheckpointCorrupt  -> when OUR resume checkpoint won't load, fall
                         back to a fresh start (correct, just slower).
   CheckpointMismatch -> unsound to resume; fatal immediately.
@@ -40,6 +50,8 @@ from .errors import (
     CheckpointError,
     CheckpointMismatch,
     InjectedCrash,
+    ShardLost,
+    ShardStall,
     UnrecoverableError,
     is_transient,
 )
@@ -70,17 +82,25 @@ def supervise(
     seed: int = 0,
     telemetry=None,
     verbose: bool = False,
+    stats_out: dict | None = None,
 ):
     """Run ``engine_factory(overrides).run(**run_kw)`` to completion.
 
     ``engine_factory`` builds a FRESH engine from a dict of constructor
     overrides (empty on the first attempt; grown capacities after an
-    overflow). ``run_kw`` must route checkpoints (``checkpoint_path``)
-    for any recovery beyond pure transient-retry to be possible; the
-    supervisor flips its ``resume`` to the newest intact generation on
-    each recovery attempt. ``max_retries`` bounds RECOVERIES, not
-    attempts: attempt 1 is free, and every classified failure after it
-    consumes one retry.
+    overflow, a shrunk device list after a shard loss). A factory may
+    return a cached engine when the overrides are empty — that is what
+    keeps fleet recoveries recompile-free. ``run_kw`` must route
+    checkpoints (``checkpoint_path``) for any recovery beyond pure
+    transient-retry to be possible; the supervisor flips its ``resume``
+    to the newest intact generation on each recovery attempt.
+    ``max_retries`` bounds RECOVERIES, not attempts: attempt 1 is free,
+    and every classified failure after it consumes one retry.
+
+    ``stats_out``: optional dict the supervisor fills in place —
+    ``recoveries`` (classified failures recovered from) and ``causes``
+    (one classification string per recovery) — so fleet drivers can
+    record per-job recovery counts without parsing telemetry.
 
     Returns whatever ``engine.run`` returns. Raises UnrecoverableError
     (with the last failure as ``__cause__``) when the budget is spent
@@ -125,6 +145,9 @@ def supervise(
                 f"(last failure: {type(exc).__name__}: {exc})"
             ) from exc
         retries_left -= 1
+        if stats_out is not None:
+            stats_out["recoveries"] = stats_out.get("recoveries", 0) + 1
+            stats_out.setdefault("causes", []).append(cause)
         delay = _backoff()
         _emit_retry(cause, delay)
         if delay > 0:
@@ -144,16 +167,39 @@ def supervise(
                 ) from exc
             _spend(exc, f"overflow:{'+'.join(exc.what) or exc.bits}")
             overrides.update(growth)
-            # resume from the newest checkpoint when one exists; the
-            # sharded engine cannot write a wave-start checkpoint at its
-            # abort point (the LSM already holds the aborted wave's
-            # fingerprints), so a fresh start with grown caps is the
-            # fallback — sound, just re-explores
+            # resume from the newest checkpoint when one exists; every
+            # engine (the sharded one included, since it learned to
+            # subtract the aborted wave's fingerprints back out of its
+            # LSM) writes a wave-start checkpoint at the abort point
+            # whenever a checkpoint path is routed, so a grown resume
+            # normally loses zero work. A fresh start remains the
+            # fallback — sound, just re-explores.
             run_kw["resume"] = (
                 ckpt_path
                 if exc.checkpoint_saved or has_checkpoint(ckpt_path, keep)
                 else None
             )
+            continue
+        except ShardLost as exc:
+            survivors = getattr(engine, "survivors_for_shard_loss", None)
+            shrink = survivors(exc.shard) if survivors is not None else None
+            if shrink is None:
+                raise UnrecoverableError(
+                    f"shard {exc.shard} lost with no surviving mesh to "
+                    f"reshard onto: {exc}"
+                ) from exc
+            _spend(exc, f"shard-lost:{exc.shard}")
+            overrides.update(shrink)
+            run_kw["resume"] = (
+                ckpt_path
+                if exc.checkpoint_saved or has_checkpoint(ckpt_path, keep)
+                else None
+            )
+            continue
+        except ShardStall as exc:
+            _spend(exc, f"shard-stall:{exc.shard}")
+            if exc.checkpoint_saved or has_checkpoint(ckpt_path, keep):
+                run_kw["resume"] = ckpt_path
             continue
         except CheckpointMismatch:
             raise  # unsound to recover; the caller picked a wrong file

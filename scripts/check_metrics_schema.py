@@ -17,7 +17,13 @@ preempt, from the self-healing runtime) are validated too: retry
 attempts must be ints >= 1 strictly increasing across a supervised
 session (a summary resets the counter), backoff_s non-negative,
 resume/ckpt_generation generations ints >= 0, and ckpt_generation
-skipped-diagnostics a list of strings. Job-tagged streams (the one
+skipped-diagnostics a list of strings. The elastic-mesh events ride the
+same rules: a reshard (load-time fp-mod-D re-routing of a checkpoint
+written on a different mesh size) must appear after the manifest but
+before any wave and carry distinct from_d/to_d >= 1, while shard_lost /
+shard_stall must name a shard index inside the mesh (0 <= shard <
+device_count), carry a wave no older than the run's last completed
+wave, and come before the summary. Job-tagged streams (the one
 multiplexed file a `raft_tpu sweep --metrics-out` run writes) get the
 fleet rules: a `job` tag must be a non-empty string, each job's wave
 indices must be strictly increasing within its run, and every job

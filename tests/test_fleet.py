@@ -11,6 +11,7 @@ fingerprint-value-independent, so any divergence is a packing bug.
 
 import functools
 import json
+import os
 
 import pytest
 
@@ -393,6 +394,131 @@ def test_run_sweep_host_and_resume(tmp_path):
     ))
     assert res2.precompiles == 0
     assert all(j.skipped and j.rc == 0 for j in res2.jobs)
+
+
+def test_fleet_lineage_names_do_not_collide(tmp_path):
+    """Regression: job names that sanitize to the same string ("a/b" and
+    "a_b") used to alias one checkpoint lineage; the job-index suffix
+    keeps them distinct on disk."""
+    from raft_tpu.checker.device_bfs import DeviceBFS
+    from raft_tpu.resilience import lineage_name
+
+    obj = {
+        "spec": "Raft",
+        "defaults": dict(STD_MANIFEST["defaults"]),
+        "jobs": [{"name": "a/b"}, {"name": "a_b"}],
+    }
+    mf = _mf(obj)
+    (group,) = group_jobs(mf)
+    model = build_packed(group)
+    setup = group.setups[0]
+    names = [j.name for j in group.jobs]
+    ckdir = str(tmp_path / "ckpt")
+    eng = DeviceBFS(model, invariants=setup.invariants,
+                    symmetry=setup.symmetry, chunk=256,
+                    frontier_cap=1 << 12, seen_cap=1 << 15,
+                    journal_cap=1 << 15)
+    eng.run_fleet(job_names=names, checkpoint_dir=ckdir,
+                  checkpoint_every_s=0.0, max_depth=1)
+    files = sorted(os.listdir(ckdir))
+    lineages = [f for f in files if f.endswith(".ckpt.npz")]
+    assert lineage_name("a/b", 0) in lineages
+    assert lineage_name("a_b", 1) in lineages
+    assert len({lineage_name(n, i) for i, n in enumerate(names)}) == 2
+
+
+@pytest.mark.slow
+def test_run_sweep_supervised_recovers_and_records(tmp_path):
+    """Supervised sweep: a job with injected chaos (crash at wave 2)
+    recovers inside its budget, reports rc 0 with its recovery count in
+    the JobResult JSON and fleet_state.json; with budget 0 the same
+    fault becomes an rc-5 unrecoverable result that does NOT kill the
+    other job."""
+    # names match SM_MANIFEST's grid auto-names so _serial_ref("sm")
+    # provides the fault-free parity references
+    names = ["Raft-MaxElections=1", "Raft-MaxElections=2"]
+    obj = {
+        "spec": "Raft",
+        "defaults": dict(STD_MANIFEST["defaults"]),
+        "jobs": [
+            {"name": names[0]},
+            {"name": names[1], "constants": {"MaxElections": 2},
+             "chaos": "crash=2"},
+        ],
+    }
+    serial = _serial_ref("sm")
+    res = run_sweep(_mf(obj), SweepOptions(
+        engine="tpu", max_depth=STD_DEPTH, chunk=512,
+        state_dir=str(tmp_path / "s1"), supervise=2,
+    ))
+    assert res.rc == 0
+    by_name = {j.name: j for j in res.jobs}
+    assert by_name[names[0]].recoveries == 0
+    assert by_name[names[1]].recoveries == 1
+    assert by_name[names[1]].to_json()["recoveries"] == 1
+    # recovery is exploration-neutral: counts match the serial refs
+    for n in names:
+        assert by_name[n].distinct == serial[n].distinct, n
+    state = json.loads(
+        (tmp_path / "s1" / "fleet_state.json").read_text())
+    assert state["completed"] == {n: 0 for n in names}
+    assert state["recoveries"][names[1]] == 1
+    # budget 0: the crash is terminal for its job only
+    res = run_sweep(_mf(obj), SweepOptions(
+        engine="tpu", max_depth=STD_DEPTH, chunk=512,
+        state_dir=str(tmp_path / "s2"), supervise=0,
+    ))
+    assert res.rc == 5
+    by_name = {j.name: j for j in res.jobs}
+    assert by_name[names[0]].rc == 0
+    assert by_name[names[1]].rc == 5
+    assert by_name[names[1]].exit_cause == "unrecoverable"
+
+
+@pytest.mark.slow
+def test_fleet_supervised_8_jobs_one_crashing_twice(tmp_path, monkeypatch):
+    """The acceptance sweep: 8 jobs, one suffering two injected faults;
+    everything finishes rc 0, the recovery count is recorded, and NO
+    recovery triggered an engine rebuild (empty-override recoveries ride
+    the group's compiled programs — zero recompiles)."""
+    from raft_tpu.checker.device_bfs import DeviceBFS
+
+    grid_jobs = [
+        {"name": f"g-ME={me}-MR={mr}",
+         "constants": {"MaxElections": me, "MaxRestarts": mr}}
+        for me in (1, 2) for mr in (0, 1)
+    ]
+    twin_jobs = [dict(j, name=j["name"].replace("g-", "t-"))
+                 for j in grid_jobs]
+    # one twin crashes at wave 2 and flakes at wave 3: two recoveries
+    twin_jobs[2]["chaos"] = "crash=2,transient=3"
+    obj = {"spec": "Raft", "defaults": dict(STD_MANIFEST["defaults"]),
+           "jobs": grid_jobs + twin_jobs}
+    mf = _mf(obj)
+    assert len(mf.jobs) == 8
+
+    def no_rebuild(self, overrides):
+        raise AssertionError(
+            f"recovery caused an engine rebuild: {overrides}")
+
+    monkeypatch.setattr(DeviceBFS, "_rebuild", no_rebuild)
+    res = run_sweep(mf, SweepOptions(
+        engine="tpu", max_depth=STD_DEPTH, chunk=512,
+        state_dir=str(tmp_path), supervise=5,
+    ))
+    assert res.rc == 0
+    assert all(j.rc == 0 for j in res.jobs)
+    by_name = {j.name: j for j in res.jobs}
+    crashed = twin_jobs[2]["name"]
+    assert by_name[crashed].recoveries == 2
+    # the chaos job's counts equal its fault-free twin's
+    twin = crashed.replace("t-", "g-")
+    assert by_name[crashed].distinct == by_name[twin].distinct
+    assert by_name[crashed].total == by_name[twin].total
+    state = json.loads((tmp_path / "fleet_state.json").read_text())
+    assert state["recoveries"][crashed] == 2
+    assert all(v == 0 for n, v in state["recoveries"].items()
+               if n != crashed)
 
 
 def test_run_sweep_jobs_glob():

@@ -199,6 +199,7 @@ def test_schema_and_renderer_stay_in_sync():
     assert tuple(n for n, _ in DECLARED_EVENTS) == (
         "manifest", "wave", "stall", "coverage", "summary",
         "retry", "resume", "ckpt_generation", "preempt",
+        "shard_lost", "reshard", "shard_stall",
     )
     for _, keys in DECLARED_EVENTS:
         assert keys[0] == "event"

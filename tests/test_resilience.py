@@ -153,6 +153,38 @@ def test_validate_resume_paths(tmp_path):
         rckpt.validate_resume(path, "other/spec")
 
 
+def test_mesh_helpers_and_lineage_name():
+    assert rckpt.mesh_d_of("sharded/D=8/raft/hashv=3") == 8
+    assert rckpt.mesh_d_of("host/raft/hashv=3") is None
+    assert rckpt.mesh_neutral("sharded/D=8/raft") == "sharded/raft"
+    assert rckpt.mesh_neutral("sharded/D=4/raft") == rckpt.mesh_neutral(
+        "sharded/D=1/raft")
+    # lineage names disambiguate by fleet position: sanitizing alone
+    # maps "a/b" and "a_b" to the same file (the collision this fixes)
+    assert rckpt.lineage_name("a/b", 0) != rckpt.lineage_name("a_b", 1)
+    assert rckpt.lineage_name("a/b", 0) == "a_b.j0.ckpt.npz"
+    names = {rckpt.lineage_name(n, i)
+             for i, n in enumerate(["a/b", "a_b", "a b"])}
+    assert len(names) == 3
+
+
+def test_check_spec_mesh_portability_gate():
+    d4 = {"spec": "sharded/D=4/raft/hashv=3"}
+    ident2 = "sharded/D=2/raft/hashv=3"
+    # mesh-only mismatch: resharding allowed -> accepted
+    rckpt.check_spec(d4, ident2, "p.npz", allow_reshard=True)
+    # refused with a message naming BOTH mesh sizes and the reshard path
+    with pytest.raises(CheckpointMismatch) as ei:
+        rckpt.check_spec(d4, ident2, "p.npz")
+    msg = str(ei.value)
+    assert "D=4" in msg and "D=2" in msg and "mesh-portable" in msg
+    # a real identity difference is never resharded over
+    with pytest.raises(CheckpointMismatch, match="checkpoint is for spec"):
+        rckpt.check_spec(
+            {"spec": "sharded/D=4/raft/hashv=2"}, ident2, "p.npz",
+            allow_reshard=True)
+
+
 # ------------------------------------------------------- chaos harness
 
 
@@ -161,9 +193,19 @@ def test_chaos_spec_grammar():
     assert spec.crash == 3 and spec.truncate == 2 and spec.seed == 7
     assert "crash=3" in str(spec)
     for bad in ("crash", "crash=zero", "bogus=1", "crash=1,crash=2",
-                "crash=0"):
+                "crash=0", "shard_loss=0"):
         with pytest.raises(ValueError):
             ChaosSpec.parse(bad)
+    spec = ChaosSpec.parse("shard_loss=2,seed=5")
+    assert spec.shard_loss == 2 and "shard_loss=2" in str(spec)
+
+
+def test_chaos_shard_loss_hook_fires_once_and_is_seeded():
+    inj = ChaosInjector(ChaosSpec.parse("shard_loss=2,seed=5"))
+    assert inj.shard_loss(1, 4) is None
+    assert inj.shard_loss(2, 4) == 5 % 4  # the doomed shard is seed % D
+    assert inj.shard_loss(2, 4) is None  # consumed: resumes pass freely
+    assert inj.fired == ["shard_loss"]
 
 
 def test_chaos_faults_fire_exactly_once():
@@ -304,6 +346,62 @@ def test_supervise_preempted_result_is_returned():
     assert res.exit_cause == "preempted"
 
 
+class _MeshEngine(_ScriptedEngine):
+    """Scripted engine with a 4-device mesh: shard loss hands the
+    supervisor the survivor list, like ShardedBFS does."""
+
+    devices = ["d0", "d1", "d2", "d3"]
+
+    def survivors_for_shard_loss(self, shard):
+        devs = [d for i, d in enumerate(self.devices) if i != shard % 4]
+        return {"devices": devs} if len(self.devices) > 1 else None
+
+
+def test_supervise_shard_lost_reshards_onto_survivors(tmp_path):
+    from raft_tpu.resilience import ShardLost
+
+    ck = str(tmp_path / "ck.npz")
+    log, stats = [], {}
+    exc = ShardLost("shard 2 lost", shard=2, checkpoint_saved=True)
+    res = supervise(
+        lambda ov: _MeshEngine([exc] if not ov else [], ov, log),
+        {"checkpoint_path": ck}, backoff_base=0.0, stats_out=stats)
+    assert res.distinct == 42
+    # attempt 2 rebuilt on the D-1 survivor mesh and resumed the
+    # wave-start checkpoint the engine spilled before raising
+    assert log[1]["overrides"] == {"devices": ["d0", "d1", "d3"]}
+    assert log[1]["resume"] == ck
+    assert stats == {"recoveries": 1, "causes": ["shard-lost:2"]}
+
+
+def test_supervise_shard_lost_single_device_is_fatal():
+    from raft_tpu.resilience import ShardLost
+
+    class _Solo(_ScriptedEngine):
+        def survivors_for_shard_loss(self, shard):
+            return None  # D=1: nobody left to reshard onto
+
+    exc = ShardLost("shard 0 lost", shard=0, checkpoint_saved=True)
+    with pytest.raises(UnrecoverableError, match="no surviving mesh"):
+        supervise(lambda ov: _Solo([exc], ov, []), {}, backoff_base=0.0)
+
+
+def test_supervise_shard_stall_resumes_same_mesh(tmp_path):
+    from raft_tpu.resilience import ShardStall
+
+    ck = str(tmp_path / "ck.npz")
+    log, stats = [], {}
+    exc = ShardStall("wave 5 stalled", shard=1, wave_s=9.0, median_s=1.0,
+                     checkpoint_saved=True)
+    res = supervise(_scripted_factory([exc], log),
+                    {"checkpoint_path": ck}, backoff_base=0.0,
+                    stats_out=stats)
+    assert res.distinct == 42
+    # a stall is a transient: same mesh (no overrides), resume
+    assert log[1] == {"overrides": {}, "resume": ck}
+    assert stats["causes"] == ["shard-stall:1"]
+
+
 def test_supervise_emits_retry_events(tmp_path):
     ck = str(tmp_path / "ck.npz")
     rckpt.save_npz(ck, _payload())
@@ -351,6 +449,34 @@ def test_resilience_events_validate():
              for a in (1, 1)]
     _, problems = validate_lines(lines)
     assert any("attempt" in p for p in problems)
+
+
+def test_elastic_mesh_events_validate():
+    from raft_tpu.obs.events import validate_event, validate_lines
+
+    lost = {"event": "shard_lost", "wave": 3, "depth": 2, "shard": 1,
+            "device_count": 4, "checkpoint_saved": True}
+    resh = {"event": "reshard", "path": "ck.npz", "from_d": 4, "to_d": 2,
+            "depth": 3, "distinct": 99}
+    stall = {"event": "shard_stall", "wave": 5, "depth": 4, "shard": 0,
+             "wave_s": 9.0, "median_wave_s": 1.0, "factor": 9.0}
+    for ev in (lost, resh, stall):
+        assert validate_event(ev) == [], ev
+    # per-event field rules
+    assert validate_event(dict(lost, shard=4))  # shard out of mesh range
+    assert validate_event(dict(lost, device_count=0))
+    assert validate_event(dict(resh, from_d=2))  # same-size "reshard"
+    assert validate_event(dict(resh, to_d=0))
+    assert validate_event(dict(stall, shard=-1))
+    # structural: reshard belongs to the load phase, before any wave
+    wave = {"event": "wave", "wave": 1}
+    _, problems = validate_lines(
+        [json.dumps(wave), json.dumps(resh)])
+    assert any("before any wave" in p for p in problems)
+    # shard_lost may not report a wave behind the last completed one
+    _, problems = validate_lines(
+        [json.dumps(dict(wave, wave=4)), json.dumps(lost)])
+    assert any("behind" in p for p in problems)
 
 
 # ------------------------------------------------------- host engine
@@ -561,6 +687,40 @@ def test_cli_resume_failfast_exit_codes(tmp_path, capsys):
     assert rc == 64
 
 
+def test_cli_sharded_no_reshard_mesh_mismatch_is_exit_64(tmp_path, capsys):
+    """Satellite gate: a mesh-size-only mismatch under --no-reshard is a
+    usage error (64) whose message names BOTH mesh sizes and the reshard
+    path — and it fails fast in validate_resume, before any compile."""
+    import jax
+
+    from raft_tpu.__main__ import main
+    from raft_tpu.models.raft import RaftParams, cached_model
+    from raft_tpu.parallel.sharded import ShardedBFS
+
+    cfg = _cfg(tmp_path)
+    model = cached_model(RaftParams(n_servers=2, n_values=1,
+                                    max_elections=1, max_restarts=0,
+                                    msg_slots=16))
+    # symmetry=False: CFG declares no SYMMETRY, and the ident must match
+    # the CLI run's exactly except for the /D=n/ component
+    eng = ShardedBFS(model, invariants=("NoLogDivergence",), symmetry=False,
+                     devices=jax.devices()[:1], chunk=256,
+                     frontier_cap=256, seen_cap=1024, journal_cap=1024)
+    ident = eng._ckpt_ident()
+    assert "/D=1/" in ident
+    spec_d2 = ident.replace("/D=1/", "/D=2/")
+    assert rckpt.mesh_neutral(spec_d2) == rckpt.mesh_neutral(ident)
+    ck = str(tmp_path / "d2.npz")
+    rckpt.save_npz(ck, dict(version=2, spec=spec_d2, depth=3))
+    rc = main([cfg, "--platform", "cpu", "--checker", "sharded",
+               "--devices", "1", "--msg-slots", "16", "--chunk", "256",
+               "--no-reshard", "--resume", ck])
+    cap = capsys.readouterr()
+    assert rc == 64, cap.err
+    assert "D=2 mesh" in cap.err and "D=1" in cap.err
+    assert "mesh-portable" in cap.err
+
+
 # ----------------------------------------------- device/sharded (slow)
 
 
@@ -576,9 +736,12 @@ def _engine_factory(kind, model, inv):
 
     from raft_tpu.parallel.sharded import ShardedBFS
 
+    # devices sits in the defaults dict so shard-loss recovery can
+    # override it with the survivor list
     return lambda ov: ShardedBFS(
-        model, invariants=inv, symmetry=True, devices=jax.devices()[:4],
-        **{**dict(chunk=128, frontier_cap=1024, seen_cap=4096), **ov})
+        model, invariants=inv, symmetry=True,
+        **{**dict(devices=jax.devices()[:4], chunk=128, frontier_cap=1024,
+                  seen_cap=4096), **ov})
 
 
 @pytest.mark.slow
@@ -631,6 +794,65 @@ def test_engine_v1_backcompat(kind, tmp_path):
         int(x) for x in ref.depth_counts]
     assert sum(r[2] for r in res.coverage) == ref.distinct - sum(
         ref.depth_counts[:3])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["raft", "kraft"])
+def test_sharded_shard_loss_supervised_parity(family, tmp_path):
+    """The elastic-mesh gate: a shard's device dies mid-wave 2 on a D=4
+    mesh; the supervisor reshards the spilled wave-start checkpoint onto
+    the surviving D=3 mesh and the final counts are bit-identical to the
+    fault-free run — on both model families."""
+    model = cached_model(RAFT2) if family == "raft" else _kraft()
+    inv = _first_inv(model)
+    factory = _engine_factory("sharded", model, inv)
+    ref = factory({}).run(max_depth=4)
+
+    ck = str(tmp_path / "ck.npz")
+    chaos = ChaosInjector(ChaosSpec.parse("shard_loss=2,seed=1"))
+    stats: dict = {}
+    res = supervise(
+        factory,
+        dict(max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0,
+             chaos=chaos),
+        backoff_base=0.0, stats_out=stats,
+    )
+    assert chaos.fired == ["shard_loss"]
+    assert stats == {"recoveries": 1, "causes": ["shard-lost:1"]}
+    assert res.distinct == ref.distinct
+    assert [int(x) for x in res.depth_counts] == [
+        int(x) for x in ref.depth_counts]
+    assert res.total == ref.total and res.terminal == ref.terminal
+    # the new-state column's per-action split depends on mesh size (it
+    # credits dedup-race winners), so compare the mesh-invariant
+    # enabled/fired tallies exactly and the new-state total
+    cov_r = np.asarray(ref.coverage)
+    cov_n = np.asarray(res.coverage)
+    assert (cov_r[:, :2] == cov_n[:, :2]).all()
+    assert cov_r[:, 2].sum() == cov_n[:, 2].sum()
+
+
+@pytest.mark.slow
+def test_sharded_stall_watchdog_aborts_with_wave_start_checkpoint(tmp_path):
+    """stall_abort_factor=0.0 makes the first eligible wave (the 4th:
+    three must be recorded to calibrate the median) trip the watchdog;
+    the raise carries a wave-start checkpoint a plain resume completes
+    from with zero lost work."""
+    from raft_tpu.resilience import ShardStall
+
+    model = cached_model(RAFT2)
+    inv = _first_inv(model)
+    factory = _engine_factory("sharded", model, inv)
+    ref = factory({}).run(max_depth=6)
+    ck = str(tmp_path / "ck.npz")
+    with pytest.raises(ShardStall) as ei:
+        factory({}).run(max_depth=6, checkpoint_path=ck,
+                        checkpoint_every_s=1e9, stall_abort_factor=0.0)
+    assert ei.value.checkpoint_saved and 0 <= ei.value.shard < 4
+    res = factory({}).run(resume=ck, max_depth=6)
+    assert res.distinct == ref.distinct
+    assert [int(x) for x in res.depth_counts] == [
+        int(x) for x in ref.depth_counts]
 
 
 @pytest.mark.slow

@@ -152,14 +152,130 @@ def test_sharded_checkpoint_resume(tmp_path):
     assert r2.terminal == ref.terminal
 
 
+def test_reshard_smoke_d2_to_d1(tmp_path):
+    """Tier-1 elastic-mesh smoke on the CPU mesh: a D=2 checkpoint
+    resumes on a D=1 mesh via the load-time fp%D re-route, with exact
+    oracle parity; reshard=False refuses with a message naming both
+    mesh sizes."""
+    from raft_tpu.obs import Telemetry
+
+    p = RaftParams(n_servers=2, n_values=1, max_elections=1,
+                   max_restarts=0, msg_slots=16)
+    model = cached_model(p)
+    kw = dict(invariants=("NoLogDivergence",), symmetry=True, chunk=256,
+              frontier_cap=1024, seen_cap=1 << 12)
+    ck = str(tmp_path / "sh.npz")
+    r1 = ShardedBFS(model, devices=jax.devices()[:2], **kw).run(
+        max_depth=2, checkpoint_path=ck, checkpoint_every_s=0.0)
+    assert r1.depth == 2
+    eng1 = ShardedBFS(model, devices=jax.devices()[:1], **kw)
+    # refusal: fails fast in check_spec, before the D=1 precompile
+    with pytest.raises(ValueError) as ei:
+        eng1.run(resume=ck, reshard=False)
+    assert "D=2 mesh" in str(ei.value) and "D=1" in str(ei.value)
+    tel = Telemetry()
+    res = eng1.run(resume=ck, max_depth=4, telemetry=tel)
+    ores = RaftOracle(2, 1, 1, 0).bfs(invariants=(), symmetry=True,
+                                      max_depth=4)
+    assert res.distinct == ores["distinct"]
+    assert list(res.depth_counts) == list(ores["depth_counts"])
+    resh = [e for e in tel.events if e["event"] == "reshard"]
+    assert len(resh) == 1
+    assert resh[0]["from_d"] == 2 and resh[0]["to_d"] == 1
+    assert resh[0]["depth"] == 2
+
+
 @pytest.mark.slow
-def test_sharded_checkpoint_mesh_mismatch(tmp_path):
-    """A checkpoint is bound to its mesh size (fp%D ownership): resuming
-    on a different D must be refused, not silently mis-shard."""
+def test_sharded_checkpoint_mesh_portable(tmp_path):
+    """Checkpoints are mesh-portable: the payload carries per-shard
+    sorted-fingerprint segments (D is provenance, not identity), so a
+    D=4 checkpoint resumes on D=2 and D=1 with counts bit-identical to
+    the uninterrupted D=4 run — the preemptible-mesh story."""
+    model = cached_model(PARAMS)
+    kw = dict(invariants=("LeaderHasAllAckedValues", "NoLogDivergence"),
+              symmetry=True, chunk=128, frontier_cap=1024, seen_cap=4096)
+    ref = ShardedBFS(model, devices=jax.devices()[:4], **kw).run()
+    ck = str(tmp_path / "sh.npz")
+    r1 = ShardedBFS(model, devices=jax.devices()[:4], **kw).run(
+        max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0)
+    assert not r1.exhausted
+    for ndev in (2, 1):
+        res = ShardedBFS(model, devices=jax.devices()[:ndev], **kw).run(
+            resume=ck)
+        assert res.exhausted, ndev
+        assert res.distinct == ref.distinct, ndev
+        assert list(res.depth_counts) == list(ref.depth_counts), ndev
+        assert res.total == ref.total and res.terminal == ref.terminal
+        # enabled/fired tallies are mesh-invariant; the new-state column
+        # credits whichever action's successor won the dedup race, and
+        # that tie-break legitimately depends on shard routing order
+        # (true of unbroken runs at different D too) — so compare its
+        # total, not its per-action split
+        cov_r, cov_n = np.asarray(ref.coverage), np.asarray(res.coverage)
+        assert (cov_r[:, :2] == cov_n[:, :2]).all(), ndev
+        assert cov_r[:, 2].sum() == cov_n[:, 2].sum(), ndev
+
+
+@pytest.mark.slow
+def test_sharded_reshard_preserves_violation_trace(tmp_path):
+    """A resharded resume must find the same violation at the same depth
+    with a replay-valid counterexample of the same length — parent
+    pointers survive the owner re-route."""
+    import jax.numpy as jnp
+
+    model = cached_model(PARAMS)
+    lay = model.layout
+
+    def no_commit(states):
+        return jnp.all(lay.get(states, "commitIndex") == 0, axis=1)
+
+    model.invariants["NoCommit"] = no_commit
+    try:
+        kw = dict(invariants=("NoCommit",), chunk=512, frontier_cap=1024,
+                  seen_cap=1 << 12)
+        ref = ShardedBFS(model, devices=jax.devices()[:4], **kw).run()
+        assert ref.violation_invariant == "NoCommit"
+        ck = str(tmp_path / "sh.npz")
+        ShardedBFS(model, devices=jax.devices()[:4], **kw).run(
+            max_depth=2, checkpoint_path=ck, checkpoint_every_s=0.0)
+        res = ShardedBFS(model, devices=jax.devices()[:2], **kw).run(
+            resume=ck)
+        assert res.violation_invariant == "NoCommit"
+        assert res.depth == ref.depth
+        # trace replay asserts every journalled candidate is enabled, so
+        # reaching here proves the resharded parent chain is real
+        assert len(res.trace) == len(ref.trace)
+        final = res.trace[-1][1]
+        assert any(ci > 0 for ci in final["commitIndex"])
+    finally:
+        del model.invariants["NoCommit"]
+
+
+@pytest.mark.slow
+def test_sharded_ovf_abort_spills_wave_start_checkpoint(tmp_path):
+    """A capacity abort now spills a redistributable wave-start
+    checkpoint (LSM subtraction via the jfp lane) before raising, so a
+    grown resume loses zero work — parity with DeviceBFS."""
+    from raft_tpu.resilience import (
+        CapacityOverflow, ChaosInjector, ChaosSpec,
+    )
+
     model = cached_model(PARAMS)
     kw = dict(invariants=(), chunk=128, frontier_cap=1024, seen_cap=4096)
+    ref = ShardedBFS(model, devices=jax.devices()[:4], **kw).run(
+        max_depth=5)
     ck = str(tmp_path / "sh.npz")
-    ShardedBFS(model, devices=jax.devices()[:4], **kw).run(
-        max_depth=4, checkpoint_path=ck, checkpoint_every_s=0.0)
-    with pytest.raises(ValueError, match="checkpoint is for spec"):
-        ShardedBFS(model, devices=jax.devices()[:2], **kw).run(resume=ck)
+    eng = ShardedBFS(model, devices=jax.devices()[:4], **kw)
+    chaos = ChaosInjector(ChaosSpec.parse("ovf=3"))
+    with pytest.raises(CapacityOverflow) as ei:
+        eng.run(max_depth=5, checkpoint_path=ck, checkpoint_every_s=1e9,
+                chaos=chaos)
+    assert ei.value.checkpoint_saved
+    assert "wave-start checkpoint saved" in str(ei.value)
+    growth = eng.grow_for_overflow(ei.value.bits)
+    assert growth  # the spurious bit is the growable frontier bit
+    res = ShardedBFS(model, devices=jax.devices()[:4],
+                     **{**kw, **growth}).run(resume=ck, max_depth=5)
+    assert res.distinct == ref.distinct
+    assert list(res.depth_counts) == list(ref.depth_counts)
+    assert res.total == ref.total and res.terminal == ref.terminal
