@@ -305,6 +305,22 @@ def main():
 
     _, metrics_problems = validate_file(metrics_path)
 
+    # optional perf-regression gate: when BENCH_GATE_BASELINE names a
+    # baseline JSON (scripts/bench_gate.py format), the deep-run summary
+    # is gated against it and the verdict rides the provenance block —
+    # the bench stays a measurement, the gate verdict travels with it
+    gate_baseline = os.environ.get("BENCH_GATE_BASELINE")
+    bench_gate_verdict = None
+    if gate_baseline:
+        from scripts.bench_gate import evaluate as gate_evaluate
+
+        try:
+            with open(gate_baseline) as fh:
+                bench_gate_verdict = gate_evaluate(deep_summary, json.load(fh))
+        except (OSError, ValueError) as e:
+            bench_gate_verdict = {"error": f"{type(e).__name__}: {e}"}
+        bench_gate_verdict["baseline_file"] = gate_baseline
+
     # 2. parity gate at a second chunk geometry (defense against the
     # batch-geometry miscompile class, ops/bag.py)
     small_chunk = chunk // 2 if chunk // 2 >= 128 else chunk * 2
@@ -416,6 +432,7 @@ def main():
                 "schema_ok": not metrics_problems,
                 "problems": metrics_problems[:5],
             },
+            "bench_gate": bench_gate_verdict,
             "same_depth_cmp": {
                 "depth": cmp_depth,
                 "distinct": tpu_cmp.distinct,

@@ -224,6 +224,19 @@ def main(argv=None):
         "so the stream stays count-accurate)",
     )
     ap.add_argument(
+        "--timeline",
+        nargs="?",
+        const=8,
+        type=int,
+        default=0,
+        metavar="EVERY_N",
+        help="wave-timeline observatory: run every Nth wave (default 8) "
+        "as separately timed stage dispatches and emit `timeline` (and, "
+        "on the sharded checker, per-shard `shard_wave`) events into the "
+        "metrics stream; sampled waves are bit-identical to the fused "
+        "program, unsampled waves are untouched; BFS checkers only",
+    )
+    ap.add_argument(
         "--trace-dir",
         default=None,
         metavar="DIR",
@@ -572,7 +585,7 @@ def main(argv=None):
     tel = None
     if (
         args.progress is not None or args.metrics_out is not None
-        or args.trace_dir is not None or args.json
+        or args.trace_dir is not None or args.json or args.timeline
     ):
         from .obs import Telemetry
 
@@ -581,6 +594,7 @@ def main(argv=None):
             every=args.metrics_every,
             progress_every=args.progress,
             trace_dir=args.trace_dir,
+            timeline_every=args.timeline,
         )
 
     def _finish(rc: int) -> int:

@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
-from ..obs import NULL_TELEMETRY
+from ..obs import MemWatch, NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX
 from ..ops.symmetry import Canonicalizer
@@ -242,6 +242,18 @@ class BFSChecker:
             )
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
+        # wave-timeline observatory: the host engine's stages are the
+        # numpy phases the chunk loop already runs in sequence, so the
+        # "sampled" split costs only perf_counter brackets — the wave
+        # math is untouched and trivially bit-identical to an unsampled
+        # run. device_s counts the jax-facing sections (expand/guards
+        # dispatch + fetches, fingerprinting); dedup/emit/merge are host
+        # bookkeeping and land in host_s.
+        tl_every = int(getattr(tel, "timeline_every", 0) or 0)
+        tl_wave_s: list[float] = []
+        fused_wave_s: list[float] = []
+        memwatch = MemWatch(tel) if tel.active else None
+        tel_s_last = 0.0
         while len(frontier) and violation is None:
             if preempt is not None and preempt.requested:
                 exhausted = False
@@ -280,6 +292,12 @@ class BFSChecker:
                 exit_cause = "time_budget"
                 break
             tw = time.perf_counter()
+            tl_sample = tl_every > 0 and (depth + 1) % tl_every == 0
+            stage_s = {
+                "expand": 0.0, "canon": 0.0, "dedup": 0.0, "emit": 0.0,
+                "seen_merge": 0.0, "checkpoint": 0.0,
+            }
+            dev_s = 0.0
             # contiguous cursor-append emit (mirrors the device engines'
             # emit_append): survivors append at a running cursor
             wave_sb = _AppendBuf(model.layout.W, np.int32)
@@ -294,6 +312,7 @@ class BFSChecker:
             has_succ = np.zeros(len(frontier), dtype=bool)
             with tel.wave_annotation(depth + 1):
                 for off in range(0, len(frontier), B):
+                    t_exp = time.perf_counter()
                     chunk_states = frontier[off : off + B]
                     nb = len(chunk_states)
                     if nb < B:  # pad to the compiled batch shape
@@ -315,6 +334,7 @@ class BFSChecker:
                             np.array(x)
                             for x in jax.device_get((valid, rank, ovf))
                         )
+                    dev_s += time.perf_counter() - t_exp
                     valid[nb:] = False
                     if np.any(valid & ovf):
                         raise CapacityOverflow(
@@ -330,6 +350,8 @@ class BFSChecker:
                         hit = np.zeros((len(valid), K + 1), dtype=bool)
                         hit[np.arange(len(valid))[:, None], rk] = True
                         cov[:, 0] += hit[:, :K].sum(axis=0)
+                    t_can = time.perf_counter()
+                    stage_s["expand"] += t_can - t_exp
                     if self._sparse:
                         # apply pass: construct rows for the enabled
                         # lanes only, then fan their fingerprints back
@@ -353,6 +375,12 @@ class BFSChecker:
                             dtype=np.uint64,
                         )
                         fps[~valid.reshape(-1)] = U64_MAX
+                    t_dd = time.perf_counter()
+                    # the apply+fingerprint section mirrors the device
+                    # program's canon stage, so it counts as device-facing
+                    # time even on the sparse (host_apply) path
+                    stage_s["canon"] += t_dd - t_can
+                    dev_s += t_dd - t_can
                     n_cand_total += int(valid.sum())
                     has_succ[off : off + nb] = valid[:nb].any(axis=1)
 
@@ -369,6 +397,8 @@ class BFSChecker:
                     if K:
                         cov[:, 2] += np.bincount(
                             flat_rk[idx], minlength=K + 1)[:K]
+                    t_em = time.perf_counter()
+                    stage_s["dedup"] += t_em - t_dd
                     if len(idx):
                         if self._sparse:
                             # idx lanes are all enabled (U64_MAX-masked
@@ -381,6 +411,7 @@ class BFSChecker:
                         wave_pb.append(base_gid + off + idx // model.A)
                         wave_cb.append((idx % model.A).astype(np.int32))
                         wave_fps = np.sort(np.concatenate([wave_fps, fps[idx]]))
+                    stage_s["emit"] += time.perf_counter() - t_em
 
             total += n_cand_total
             terminal += int((~has_succ).sum())
@@ -393,8 +424,10 @@ class BFSChecker:
             wave_cands = wave_cb.take()
             self._parents.append(wave_parents)
             self._cands.append(wave_cands)
+            t_sm = time.perf_counter()
             with tel.annotate("seen_merge"):
                 seen = _merge_sorted(seen, wave_fps)
+            stage_s["seen_merge"] += time.perf_counter() - t_sm
             depth += 1
             depth_counts.append(len(wave_states))
             violation = self._check_invariants(wave_states, next_gid, depth)
@@ -403,18 +436,41 @@ class BFSChecker:
             distinct += len(wave_states)
             prev_frontier = len(frontier)
             frontier = wave_states
+            ckpt_s = 0.0
             if (
                 checkpoint_path is not None
                 and violation is None  # a saved file must not mask a violation
                 and time.perf_counter() - last_ckpt > checkpoint_every_s
             ):
+                t_ck = time.perf_counter()
                 self._save_checkpoint(
                     checkpoint_path, frontier, seen, distinct, total,
                     terminal, depth, base_gid, next_gid, depth_counts, cov,
                 )
                 last_ckpt = time.perf_counter()
+                ckpt_s = last_ckpt - t_ck
+                stage_s["checkpoint"] += ckpt_s
+            wave_s_val = time.perf_counter() - tw
+            if tl_every:
+                (tl_wave_s if tl_sample else fused_wave_s).append(wave_s_val)
             if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
+                hbm_frac = None
+                if memwatch is not None:
+                    # host-RAM analog of the device engines' HBM model:
+                    # the live working set is the frontier, the sorted
+                    # seen array, the parent/candidate journal and this
+                    # wave's emit block
+                    frac = memwatch.update(depth, depth, {
+                        "frontier": int(frontier.nbytes),
+                        "seen": int(seen.nbytes),
+                        "journal": int(
+                            sum(p.nbytes for p in self._parents)
+                            + sum(c.nbytes for c in self._cands)
+                        ),
+                        "wave_emit": int(emit_bytes),
+                    })
+                    hbm_frac = round(frac, 6)
                 wm = {
                     "depth": depth,
                     "frontier": prev_frontier,
@@ -448,14 +504,32 @@ class BFSChecker:
                         n_cand_total / max(1, prev_frontier * model.A), 4
                     ),
                     "expand_budget_ovf": wave_extra,
-                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "wave_s": round(wave_s_val, 3),
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
+                    "device_s": round(dev_s, 4),
+                    "host_s": round(
+                        max(0.0, wave_s_val - dev_s - ckpt_s), 4),
+                    "ckpt_s": round(ckpt_s, 4),
+                    "tel_s": round(tel_s_last, 4),
+                    "exchange_share": None,
+                    "hbm_frac": hbm_frac,
                 }
+                t_tel = time.perf_counter()
                 tel.wave(wm)
                 if tel.active:
                     tel.coverage(self._coverage_fields(
                         depth, cov, len(seen), depth_counts))
+                    if tl_sample:
+                        tel.event(
+                            "timeline", wave=depth, depth=depth,
+                            every=tl_every,
+                            stages={
+                                k: round(v, 5)
+                                for k, v in stage_s.items() if v > 0
+                            },
+                            wave_s=round(wave_s_val, 4),
+                        )
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -465,6 +539,7 @@ class BFSChecker:
                         f"{distinct/el:.0f} distinct/s",
                         file=sys.stderr,
                     )
+                tel_s_last = time.perf_counter() - t_tel
 
         if checkpoint_path is not None and violation is None and not exhausted:
             # budget/depth/preemption exit at a wave boundary: save a
@@ -485,6 +560,22 @@ class BFSChecker:
                 self._coverage_fields(depth, cov, len(seen), depth_counts),
                 final=True,
             )
+        tl_extras = {}
+        if tl_every:
+            mt = sum(tl_wave_s) / len(tl_wave_s) if tl_wave_s else None
+            mf = (
+                sum(fused_wave_s) / len(fused_wave_s)
+                if fused_wave_s else None
+            )
+            tl_extras = {
+                "timeline_every": tl_every,
+                "timeline_waves": len(tl_wave_s),
+                # per-wave extra cost of sampling, amortized over the
+                # stride (the host engine's stages are the same numpy
+                # code either way, so this should hover near zero)
+                "timeline_overhead": round((mt - mf) / (mf * tl_every), 4)
+                if mt is not None and mf else None,
+            }
         tel.close_run({
             "engine": "host",
             "ident": self._ckpt_ident(),
@@ -501,6 +592,8 @@ class BFSChecker:
             "peak_journal_cap": int(next_gid - len(self._init_distinct)),
             "seen_lanes": int(len(seen)),
             "canon_memo_hit_rate": 0.0,
+            **tl_extras,
+            **(memwatch.summary_fields() if memwatch is not None else {}),
         })
         trace = self.reconstruct_trace(violation) if violation else None
         return CheckResult(
@@ -782,6 +875,16 @@ class BFSChecker:
                     "wave_s": round(time.perf_counter() - tw, 3),
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
+                    # packed-fleet waves are not phase-split (the shared
+                    # group run is throughput-oriented); the declared
+                    # observatory keys still appear so one consumer
+                    # reads every engine's stream
+                    "device_s": 0.0,
+                    "host_s": round(time.perf_counter() - tw, 4),
+                    "ckpt_s": 0.0,
+                    "tel_s": 0.0,
+                    "exchange_share": None,
+                    "hbm_frac": None,
                     "jobs_active": int(active.sum()),
                 })
                 if verbose:

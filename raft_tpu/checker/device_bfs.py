@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..obs import NULL_TELEMETRY
+from ..obs import MemWatch, NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..ops.hashing import U64_MAX, ne_u64, sort_u64, sort_u64_with_idx
 from ..ops.symmetry import Canonicalizer
@@ -210,6 +210,10 @@ class DeviceBFS:
         self._jcand = None
         self._jcount = 0
         self._tel = NULL_TELEMETRY  # active only inside run(telemetry=...)
+        # wave-timeline observatory programs, built on first sampled
+        # wave only (a run without --timeline never compiles them)
+        self._tl_fns: dict | None = None
+        self._tl_merge_cache: dict = {}
 
     # ---------------- seen-set adapters ----------------
 
@@ -288,33 +292,22 @@ class DeviceBFS:
         return arr[arr != np.uint64(U64_MAX)]
 
     # ---------------- device programs ----------------
+    #
+    # The chunk pipeline is factored into four stage methods
+    # (_st_expand -> _st_canon -> _st_dedup -> _st_finish) that
+    # _chunk_step composes — the fused wave program traces the exact
+    # same (integer-only) ops, while the wave-timeline observatory
+    # (--timeline) dispatches the same stages as separate jits with
+    # block_until_ready between them to attribute a sampled wave's
+    # wall clock (obs/events.py TIMELINE_STAGES). Bit-identity of the
+    # two paths is parity-gated by tests/test_obs.py.
 
-    def _chunk_step(
-        self, frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
-        cursor, fcount, base_gid, occ, first, *runs,
-    ):
-        """One chunk of the current wave. stats is i64[6]:
-        [wave new count, journal count, cumulative generated,
-         cumulative terminal, overflow bits, cumulative canon memo
-        hits]; memo is the [MCAP, 2] canon memo table (threaded through
-        the wave loop, donated); cov is the i64[n_actions, 3] per-action
-        coverage accumulator — [enabled, fired, new-distinct] per Next-
-        disjunct rank, cumulative over the WHOLE run (never reset, so
-        host snapshots are monotone); occ is bool[n_levels] (probes of
-        unoccupied levels are skipped via lax.cond); first marks the
-        wave's first chunk (resets the wave-new and overflow lanes
-        in-program, saving a per-wave host->device stats upload — the
-        tunnel's dispatch latency dominates small configs). Returns
-        the chunk's new fingerprints as a sorted R0-lane run."""
+    def _st_expand(self, frontier, cursor, fcount):
+        """Stages 1-2: guard/dense expand + compaction (+ the budgeted
+        sparse apply). Returns the compacted successor block and every
+        lane the later stages consume."""
         model = self.model
         C, A, W, VC = self.chunk, self.A, self.W, self.VC
-        FCAP, JCAP = self.FCAP, self.JCAP
-
-        stats = jnp.where(
-            first,
-            stats * jnp.asarray([0, 1, 1, 1, 0, 1], dtype=stats.dtype),
-            stats,
-        )
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
         live = (jnp.arange(C, dtype=jnp.int32) + cursor) < fcount
         if self._sparse:
@@ -354,10 +347,14 @@ class DeviceBFS:
                 axis=0,
             )
             flatc = flatp[sel]  # [VC, W]
+        return (flatc, sel, selv, valid, rank, n_gen, terminal,
+                expand_ovf, compact_ovf)
 
-        # 3. canonical fingerprints on compacted lanes only, through the
-        # raw-keyed canon memo (duplicate successors skip the tiered
-        # canon; invalid lanes come back masked to U64_MAX either way)
+    def _st_canon(self, flatc, selv, memo):
+        """Stage 3: canonical fingerprints on compacted lanes only,
+        through the raw-keyed canon memo (duplicate successors skip the
+        tiered canon; invalid lanes come back masked to U64_MAX either
+        way)."""
         if self._use_memo:
             fps, memo, n_memo_hit = self.canon.fingerprints_memo(
                 flatc, selv, memo
@@ -366,12 +363,15 @@ class DeviceBFS:
             fps = self.canon._fingerprints(flatc)
             fps = jnp.where(selv, fps, U64_MAX)
             n_memo_hit = jnp.asarray(0, jnp.int32)
+        return fps, memo, n_memo_hit
 
-        # 4. dedup: probe every OCCUPIED LSM run, then first-occurrence in
-        # chunk. Runs inserted by earlier chunks of this wave are in
-        # `runs` already (the cascade is enqueued before the next chunk
-        # call), so cross-chunk in-wave dedup falls out of the same probe.
-        # Empty levels skip their binary search at runtime via cond.
+    def _st_dedup(self, fps, occ, *runs):
+        """Stage 4: probe every OCCUPIED LSM run, then first-occurrence
+        in chunk. Runs inserted by earlier chunks of this wave are in
+        ``runs`` already (the cascade is enqueued before the next chunk
+        call), so cross-chunk in-wave dedup falls out of the same probe.
+        Empty levels skip their binary search at runtime via cond."""
+        VC = self.VC
         fresh = ne_u64(fps, U64_MAX)
         for i, r in enumerate(runs):
             hit = lax.cond(
@@ -384,7 +384,20 @@ class DeviceBFS:
         rf, order = sort_u64_with_idx(fps)
         first_s = jnp.ones((VC,), bool).at[1:].set(ne_u64(rf[1:], rf[:-1]))
         first = jnp.zeros((VC,), bool).at[order].set(first_s)
-        new = fresh & first
+        return fresh & first
+
+    def _st_finish(
+        self, next_buf, jparent, jcand, viol, stats, cov, flatc, fps,
+        sel, valid, rank, new, n_gen, terminal, expand_ovf, compact_ovf,
+        n_memo_hit, cursor, base_gid,
+    ):
+        """Stages 4b-6: per-action coverage, the cursor-append emit,
+        invariants on the new states and the stats fold. Returns the
+        updated carries plus the chunk's new fingerprints as a sorted
+        R0-lane run."""
+        model = self.model
+        C, A, W, VC = self.chunk, self.A, self.W, self.VC
+        FCAP, JCAP = self.FCAP, self.JCAP
         n_new = jnp.sum(new)
 
         # 4b. per-action coverage: segment_sum over the rank/valid lanes
@@ -474,6 +487,41 @@ class DeviceBFS:
                 stats[4] | ovf_bits,
                 stats[5] + n_memo_hit,
             ]
+        )
+        return next_buf, jparent, jcand, viol, stats, cov, new_run
+
+    def _chunk_step(
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
+        cursor, fcount, base_gid, occ, first, *runs,
+    ):
+        """One chunk of the current wave (the four stage methods above,
+        composed — one traced program). stats is i64[6]:
+        [wave new count, journal count, cumulative generated,
+         cumulative terminal, overflow bits, cumulative canon memo
+        hits]; memo is the [MCAP, 2] canon memo table (threaded through
+        the wave loop, donated); cov is the i64[n_actions, 3] per-action
+        coverage accumulator — [enabled, fired, new-distinct] per Next-
+        disjunct rank, cumulative over the WHOLE run (never reset, so
+        host snapshots are monotone); occ is bool[n_levels] (probes of
+        unoccupied levels are skipped via lax.cond); first marks the
+        wave's first chunk (resets the wave-new and overflow lanes
+        in-program, saving a per-wave host->device stats upload — the
+        tunnel's dispatch latency dominates small configs). Returns
+        the chunk's new fingerprints as a sorted R0-lane run."""
+        stats = jnp.where(
+            first,
+            stats * jnp.asarray([0, 1, 1, 1, 0, 1], dtype=stats.dtype),
+            stats,
+        )
+        (flatc, sel, selv, valid, rank, n_gen, terminal, expand_ovf,
+         compact_ovf) = self._st_expand(frontier, cursor, fcount)
+        fps, memo, n_memo_hit = self._st_canon(flatc, selv, memo)
+        new = self._st_dedup(fps, occ, *runs)
+        (next_buf, jparent, jcand, viol, stats, cov,
+         new_run) = self._st_finish(
+            next_buf, jparent, jcand, viol, stats, cov, flatc, fps, sel,
+            valid, rank, new, n_gen, terminal, expand_ovf, compact_ovf,
+            n_memo_hit, cursor, base_gid,
         )
         return next_buf, jparent, jcand, viol, stats, memo, cov, new_run
 
@@ -570,6 +618,132 @@ class DeviceBFS:
              cov, *ladder0),
         )
         return out[1:]
+
+    # ---------------- wave-timeline observatory ----------------
+
+    def _tl_programs(self) -> dict:
+        """Separately jitted stage programs for sampled --timeline waves.
+        The loop-carried buffers donate exactly as in the fused program
+        (memo in canon; next_buf/journals/viol/stats/cov in finish) —
+        without donation every sampled chunk copies the full
+        capacity-shaped frontier + journal + memo through the stage
+        outputs, which dominates the sampled wave's wall clock on big
+        geometries and breaks the < 5% end-to-end overhead contract.
+        _run_timeline_wave rebinds every donated carry from the stage
+        return, so the dead inputs are never touched again."""
+        if self._tl_fns is None:
+            self._tl_fns = {
+                "expand": jax.jit(self._st_expand),
+                "canon": jax.jit(self._st_canon, donate_argnums=(2,)),
+                "dedup": jax.jit(self._st_dedup),
+                "finish": jax.jit(
+                    self._st_finish, donate_argnums=(0, 1, 2, 3, 4, 5)
+                ),
+                "statreset": jax.jit(
+                    lambda s: s * jnp.asarray([0, 1, 1, 1, 0, 1],
+                                              dtype=s.dtype),
+                    donate_argnums=(0,),
+                ),
+            }
+        return self._tl_fns
+
+    def _tl_merge_fn(self, tt: int, K: int):
+        """Cascade merge program for chain length tt (tt == K truncates
+        at the top, mirroring _wave_step.cascade's absorb branch). No
+        donation here on purpose: the concatenated sort output can never
+        alias the smaller inputs (XLA would warn, not alias), and ladder
+        runs are KiB-scale — the buffers worth donating are the
+        capacity-shaped carries in the canon/finish stages."""
+        key = (tt, K)
+        fn = self._tl_merge_cache.get(key)
+        if fn is None:
+            topsz = self.R0 << K
+            if tt < K:
+                def merge(r, *lv):
+                    return sort_u64(jnp.concatenate([r, *lv]))
+            else:
+                def merge(r, *lv):
+                    return sort_u64(jnp.concatenate([r, *lv]))[:topsz]
+            fn = jax.jit(merge)
+            self._tl_merge_cache[key] = fn
+        return fn
+
+    def _run_timeline_wave(
+        self, frontier, next_buf, jparent, jcand, viol, stats, memo, cov,
+        fcount, base_gid, stage_s,
+    ):
+        """Host-driven mirror of _wave_step for a SAMPLED --timeline
+        wave: the same stage methods the fused program composes, each
+        dispatched as its own jit with block_until_ready between them,
+        so the wave's wall clock is attributed to TIMELINE_STAGES
+        (accumulated into ``stage_s``). Bit-identical to _wave_fn: the
+        stage math is shared (integer-only ops, no FP reassociation
+        risk) and the host cascade below replays the binary-counter
+        schedule exactly — the parity gate in tests/test_obs.py pins
+        it. Returns the same tuple as _wave_fn, so run() continues
+        unchanged (ladder shapes match the fused ladder, keeping the
+        _merge_seen signature cache warm)."""
+        C = self.chunk
+        K = self._wave_geom()
+        R0 = self.R0
+        fns = self._tl_programs()
+        pc = time.perf_counter
+
+        def reset_run(i):
+            # fresh arrays on purpose: _merge_seen donates the ladder at
+            # wave end, so a cached/shared reset template would be
+            # consumed by the first merge that receives it
+            return jnp.full((R0 << i,), U64_MAX, jnp.uint64)
+
+        stats = fns["statreset"](stats)
+        occ_all = jnp.concatenate([self._occ_one, jnp.ones((K + 1,), bool)])
+        ladder = [reset_run(i) for i in range(K + 1)]
+        n_chunks = -(-int(fcount) // C)
+        for k in range(n_chunks):
+            t = pc()
+            ex = jax.block_until_ready(
+                fns["expand"](frontier, np.int32(k * C), np.int32(fcount))
+            )
+            stage_s["expand"] += pc() - t
+            (flatc, sel, selv, valid, rank, n_gen, terminal, e_ovf,
+             c_ovf) = ex
+            t = pc()
+            fps, memo, n_memo_hit = jax.block_until_ready(
+                fns["canon"](flatc, selv, memo)
+            )
+            stage_s["canon"] += pc() - t
+            t = pc()
+            new = jax.block_until_ready(
+                fns["dedup"](fps, occ_all, self._seen, *ladder)
+            )
+            stage_s["dedup"] += pc() - t
+            t = pc()
+            (next_buf, jparent, jcand, viol, stats, cov,
+             new_run) = jax.block_until_ready(fns["finish"](
+                next_buf, jparent, jcand, viol, stats, cov, flatc, fps,
+                sel, valid, rank, new, n_gen, terminal, e_ovf, c_ovf,
+                n_memo_hit, np.int32(k * C), np.int32(base_gid),
+            ))
+            stage_s["emit"] += pc() - t
+            # binary-counter cascade, host-replayed: chain length =
+            # trailing zero bits of k+1, capped at K where the top
+            # absorbs by truncate-merge (same schedule as
+            # _wave_step.cascade, so ladder contents stay identical)
+            t = pc()
+            kp1 = k + 1
+            tt = 0
+            while tt < K and kp1 % (1 << (tt + 1)) == 0:
+                tt += 1
+            if tt < K:
+                merged = self._tl_merge_fn(tt, K)(new_run, *ladder[:tt])
+            else:
+                merged = self._tl_merge_fn(K, K)(new_run, *ladder)
+            for i in range(tt):
+                ladder[i] = reset_run(i)
+            ladder[tt] = merged
+            jax.block_until_ready(ladder)
+            stage_s["seen_merge"] += pc() - t
+        return (next_buf, jparent, jcand, viol, stats, memo, cov, *ladder)
 
     # ---------------- precompile ----------------
 
@@ -828,6 +1002,22 @@ class DeviceBFS:
         metrics: list[dict] | None = [] if collect_metrics else None
         last_ckpt = time.perf_counter()
 
+        # wave-timeline observatory state: sampling stride from the
+        # telemetry facade (0 = every wave stays fused), per-path wave
+        # seconds for the overhead estimate in the summary, HBM
+        # watermark tracker (analytic — no device reads), and the
+        # previous wave's telemetry-emission cost (tel_s is only known
+        # one wave late; 0.0 on wave 1)
+        tl_every = int(getattr(tel, "timeline_every", 0) or 0)
+        tl_waves = 0
+        tl_wave_s: list[float] = []
+        fused_wave_s: list[float] = []
+        memwatch = MemWatch(tel) if tel.active else None
+        ladder_bytes = sum(
+            (self.R0 << i) * 8 for i in range(self._wave_geom() + 1)
+        )
+        tel_s_last = 0.0
+
         while fcount and violation is None:
             if preempt is not None and preempt.requested:
                 # SIGTERM/SIGINT honored at the wave boundary: the final
@@ -884,17 +1074,32 @@ class DeviceBFS:
                 )
                 last_ckpt = time.perf_counter()
             tw = time.perf_counter()
+            tl_sample = tl_every > 0 and (depth + 1) % tl_every == 0
+            stage_s = (
+                {s: 0.0 for s in ("expand", "canon", "dedup", "emit",
+                                  "seen_merge", "checkpoint")}
+                if tl_sample else None
+            )
             # ONE dispatch per wave: the chunk loop runs device-side
             # (_wave_step) and returns the wave's new fingerprints as a
             # binary-counter ladder, merged into the single seen run
             # below AFTER the overflow check (so an aborted wave leaves
-            # the seen-set untouched and the run trivially resumable)
+            # the seen-set untouched and the run trivially resumable).
+            # A sampled --timeline wave runs the same stages host-driven
+            # with per-stage timing instead (bit-identical, parity-
+            # gated); untimed waves keep the fused program.
             with tel.wave_annotation(depth + 1):
-                out = self._wave_fn(
-                    frontier, next_buf, jparent, jcand, viol, stats, memo,
-                    cov, np.int32(fcount), np.int32(base_gid),
-                    self._occ_one, self._seen,
-                )
+                if tl_sample:
+                    out = self._run_timeline_wave(
+                        frontier, next_buf, jparent, jcand, viol, stats,
+                        memo, cov, fcount, base_gid, stage_s,
+                    )
+                else:
+                    out = self._wave_fn(
+                        frontier, next_buf, jparent, jcand, viol, stats,
+                        memo, cov, np.int32(fcount), np.int32(base_gid),
+                        self._occ_one, self._seen,
+                    )
                 next_buf, jparent, jcand, viol, stats, memo, cov = out[:7]
                 ladder = out[7:]
                 # one host round-trip per wave: stats, the invariant
@@ -903,6 +1108,7 @@ class DeviceBFS:
                 # where per-wave latency dominates) — and telemetry
                 # rides this same snapshot
                 stats_h, viol_h, cov_w = jax.device_get((stats, viol, cov))
+            device_s = time.perf_counter() - tw
             stats_h = np.asarray(stats_h)
             viol_h = np.asarray(viol_h)
             ncount = int(stats_h[0])
@@ -957,7 +1163,12 @@ class DeviceBFS:
             # sort-concat; the merge-program signature set is warmed by
             # precompile)
             with tel.annotate("seen_merge"):
+                tm = time.perf_counter()
                 self._merge_seen(ladder, scount)
+                merge_s = time.perf_counter() - tm
+            device_s += merge_s
+            if stage_s is not None:
+                stage_s["seen_merge"] += merge_s
             depth += 1
             distinct += ncount
             depth_counts.append(ncount)
@@ -977,20 +1188,44 @@ class DeviceBFS:
             frontier, next_buf, jparent, jcand = self._maybe_grow(
                 ncount, frontier, next_buf, jparent, jcand, scount - n0
             )
+            ckpt_s = 0.0
             if (
                 checkpoint_path is not None
                 and violation is None  # a saved file must not mask a violation
                 and time.perf_counter() - last_ckpt > checkpoint_every_s
             ):
+                tck = time.perf_counter()
                 self._save_checkpoint(
                     checkpoint_path, frontier, jparent, jcand, fcount,
                     scount, distinct, total, terminal, depth, base_gid,
                     gen_prev, depth_counts, cov_h,
                 )
                 last_ckpt = time.perf_counter()
+                ckpt_s = last_ckpt - tck
+                if stage_s is not None:
+                    stage_s["checkpoint"] += ckpt_s
             memo_hits = int(stats_h[5])
             wave_memo = memo_hits - memo_prev
             memo_prev = memo_hits
+            wave_s_val = time.perf_counter() - tw
+            if tl_every:
+                (tl_wave_s if tl_sample else fused_wave_s).append(wave_s_val)
+                tl_waves += 1 if tl_sample else 0
+            hbm_frac = None
+            if memwatch is not None:
+                # analytic live-bytes: what the run's geometry holds in
+                # device memory right now (allocated buffers — fill-
+                # level gauges ride the wave event separately). Changes
+                # only on growth / seen-resize waves, so the memwatch
+                # event stream stays low-volume by construction.
+                hbm_frac = memwatch.update(depth, depth, {
+                    "frontier": 2 * (self.FCAP + self.VC) * 4 * W,
+                    "journal": 2 * (self.JCAP + self.VC) * 4,
+                    "seen": int(self._seen.shape[0]) * 8,
+                    "wave_ladder": ladder_bytes,
+                    "chunk": self.VC * (4 * W + 8),
+                    "memo": self.MCAP * 16 if self._use_memo else 0,
+                })
             if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
                 wm = {
@@ -1035,12 +1270,38 @@ class DeviceBFS:
                         wave_gen / max(1, prev_fcount * self.A), 4
                     ),
                     "expand_budget_ovf": (ovf_bits >> 1) & 1,
+                    # host-side phase split (perf_counter brackets the
+                    # loop already runs — zero extra device syncs):
+                    # device dispatch+sync vs checkpoint I/O vs residual
+                    # host bookkeeping; tel_s is the PREVIOUS wave's
+                    # telemetry-emission cost (only known one wave late)
+                    "device_s": round(device_s, 4),
+                    "host_s": round(
+                        max(0.0, wave_s_val - device_s - ckpt_s), 4
+                    ),
+                    "ckpt_s": round(ckpt_s, 4),
+                    "tel_s": round(tel_s_last, 4),
+                    "exchange_share": None,
+                    "hbm_frac": (
+                        round(hbm_frac, 4) if hbm_frac is not None else None
+                    ),
                 }
+                t_tel = time.perf_counter()
                 tel.wave(wm)
                 if tel.active:
                     tel.coverage(self._coverage_fields(
                         depth, cov_h, scount, depth_counts,
                     ))
+                    if tl_sample:
+                        tel.event(
+                            "timeline",
+                            wave=depth, depth=depth, every=tl_every,
+                            stages={
+                                k: round(v, 5)
+                                for k, v in stage_s.items() if v > 0
+                            },
+                            wave_s=round(wave_s_val, 4),
+                        )
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -1049,6 +1310,7 @@ class DeviceBFS:
                         f"total {total}, {distinct/el:.0f} distinct/s",
                         file=sys.stderr,
                     )
+                tel_s_last = time.perf_counter() - t_tel
 
         if checkpoint_path is not None and violation is None and not exhausted:
             # budget/depth-capped exit: the loop broke at a wave boundary,
@@ -1090,6 +1352,23 @@ class DeviceBFS:
             cf = self._coverage_fields(depth, cov_h, scount, depth_counts)
             cf["canon_memo_fill"] = memo_fill
             tel.coverage(cf, final=True)
+        # timeline overhead estimate: mean sampled vs mean fused wave
+        # seconds (null until both kinds of wave have run) — the
+        # "--timeline=N costs < 5% end-to-end" contract is checked from
+        # this summary field
+        tl_extras = {}
+        if tl_every:
+            overhead = None
+            if tl_wave_s and fused_wave_s:
+                mf = sum(fused_wave_s) / len(fused_wave_s)
+                mt = sum(tl_wave_s) / len(tl_wave_s)
+                if mf > 0:
+                    overhead = round((mt - mf) / (mf * tl_every), 4)
+            tl_extras = {
+                "timeline_every": tl_every,
+                "timeline_waves": tl_waves,
+                "timeline_overhead": overhead,
+            }
         tel.close_run({
             "engine": "device",
             "ident": self._ckpt_ident(),
@@ -1106,6 +1385,8 @@ class DeviceBFS:
             "peak_journal_cap": self.JCAP,
             "seen_lanes": int(self._seen.shape[0]),
             "canon_memo_hit_rate": round(memo_prev / max(1, gen_prev), 4),
+            **tl_extras,
+            **(memwatch.summary_fields() if memwatch is not None else {}),
         })
         trace = self.reconstruct_trace(violation) if violation else None
         res = CheckResult(

@@ -26,16 +26,21 @@ from .events import (
     EVENT_KEYS,
     EXIT_CAUSES,
     MANIFEST_KEYS,
+    MEMWATCH_KEYS,
     PREEMPT_KEYS,
     RESUME_KEYS,
     RETRY_KEYS,
+    SHARD_WAVE_KEYS,
     STALL_KEYS,
     SUMMARY_KEYS,
+    TIMELINE_KEYS,
+    TIMELINE_STAGES,
     WAVE_KEYS,
     hashv_of,
     validate_event,
     validate_lines,
 )
+from .memwatch import MemWatch, budget_from_env
 from .progress import ProgressRenderer, format_count
 from .trace import TraceHooks
 
@@ -46,18 +51,24 @@ __all__ = [
     "EVENT_KEYS",
     "EXIT_CAUSES",
     "MANIFEST_KEYS",
+    "MEMWATCH_KEYS",
     "PREEMPT_KEYS",
     "RESUME_KEYS",
     "RETRY_KEYS",
+    "SHARD_WAVE_KEYS",
     "STALL_KEYS",
     "SUMMARY_KEYS",
+    "TIMELINE_KEYS",
+    "TIMELINE_STAGES",
     "WAVE_KEYS",
     "JobTaggedTelemetry",
+    "MemWatch",
     "MetricsCollector",
     "NULL_TELEMETRY",
     "ProgressRenderer",
     "Telemetry",
     "TraceHooks",
+    "budget_from_env",
     "coverage_digest",
     "dead_actions",
     "format_count",

@@ -30,6 +30,14 @@ documented at their key tuples below; they interleave with the above
 (retry between attempts, resume/ckpt_generation right after a resumed
 run's manifest, preempt just before a "preempted" summary).
 
+The wave-timeline observatory adds three more: ``timeline`` (stage
+seconds of a sampled ``--timeline[=EVERY_N]`` wave, names drawn from
+``TIMELINE_STAGES``), ``memwatch`` (analytic HBM live-bytes watermarks
+from obs/memwatch.py, peak monotone within a run), and ``shard_wave``
+(per-shard critical-path rows of a sampled sharded wave: exchange vs
+compute seconds, emigrant lanes/bytes, work share). All three come
+before their run's summary.
+
 ``DECLARED_EVENTS`` mirrors ``DECLARED_STAGES``: the tier-1 smoke test
 pins it, so the schema cannot silently rot when an engine's stats
 plumbing changes. Engines may add EXTRA keys (e.g. the sharded checker's
@@ -61,6 +69,23 @@ MANIFEST_KEYS = (
     "invariants", "action_names", "when",
 )
 
+# Stage names the wave-timeline observatory attributes seconds to.
+# Shared by all three engines; an engine reports the subset it can
+# split (e.g. "exchange" only exists on the sharded mesh, "dedup" folds
+# into "emit" where the fused program cannot separate them). The offline
+# counterpart is checker/profile.py DECLARED_STAGES — these are coarser
+# because they time real dispatches of a real run, not isolated re-runs.
+TIMELINE_STAGES = (
+    "expand",      # guard pass + budgeted sparse apply (or dense expand)
+    "canon",       # canonical fingerprints (memoized symmetry reduction)
+    "dedup",       # seen-set probes + intra-wave first-occurrence
+    "emit",        # cursor-append emit + coverage + invariants + stats
+    "exchange",    # sharded only: the all-to-all pair on the ICI
+    "seen_merge",  # LSM ladder cascade + end-of-wave seen merge
+    "checkpoint",  # wave-boundary checkpoint I/O
+    "host",        # host bookkeeping not covered by a device stage
+)
+
 # emit_rows/emit_bytes/frontier_fill (round 6): rows the wave's
 # contiguous cursor-append emit landed, bytes it wrote, and frontier-
 # buffer occupancy (worst shard; 0.0 on the unbounded host engine) — so
@@ -74,6 +99,17 @@ MANIFEST_KEYS = (
 # 0 on surviving waves; host engine: extra fixed-size apply blocks run
 # beyond one per chunk — it loops instead of aborting). Both derive
 # from counters the wave already fetched: zero extra device syncs.
+# device_s/host_s/ckpt_s/tel_s (wave-timeline observatory): the
+# host-side phase split of the wave's wall clock — seconds blocked on
+# device work (dispatch + the one stats fetch), residual host
+# bookkeeping, checkpoint I/O, and the telemetry emission cost of the
+# PREVIOUS wave (this wave's own emission cost is only known after the
+# event is written; 0.0 on wave 1). All four come from perf_counter
+# brackets around code the wave already runs: zero extra device syncs.
+# exchange_share: sharded engine only, fraction of the sampled wave's
+# device seconds spent in the all-to-all (null on other engines and on
+# unsampled waves). hbm_frac: analytic live-bytes / budget from
+# obs/memwatch.py (null when memwatch is off).
 WAVE_KEYS = (
     "event", "wave", "depth", "frontier", "new", "distinct",
     "generated", "generated_total", "terminal", "dedup_hit_rate",
@@ -81,6 +117,8 @@ WAVE_KEYS = (
     "lsm_runs", "lsm_lanes", "wave_s", "elapsed_s", "distinct_per_s",
     "emit_rows", "emit_bytes", "frontier_fill",
     "enabled_density", "expand_budget_ovf",
+    "device_s", "host_s", "ckpt_s", "tel_s",
+    "exchange_share", "hbm_frac",
 )
 
 STALL_KEYS = (
@@ -169,6 +207,43 @@ SHARD_STALL_KEYS = (
     "event", "wave", "depth", "shard", "wave_s", "median_wave_s", "factor",
 )
 
+# wave-timeline observatory events (obs/memwatch.py + the engines'
+# sampled `--timeline[=EVERY_N]` mode):
+#   timeline    one per SAMPLED wave: the wave re-run as separately
+#               timed stage dispatches (block_until_ready between
+#               stages), bit-identical to the fused program by
+#               construction (integer-only wave math; parity-gated by
+#               tests). ``stages`` maps a TIMELINE_STAGES name to
+#               seconds; ``every`` is the sampling stride; ``wave_s``
+#               the sampled wave's total wall clock.
+#   memwatch    analytic HBM live-bytes watermark, emitted when a wave
+#               sets a new peak (so the stream stays low-volume and
+#               peak_bytes is monotone within a run by construction).
+#               ``breakdown`` maps a buffer family (frontier / chunk /
+#               seen / journal / memo / ...) to live bytes; ``frac`` =
+#               total_bytes / budget_bytes (may exceed 1.0 — that is
+#               the out-of-core planning signal).
+#   shard_wave  per-shard critical-path row of a SAMPLED sharded wave:
+#               owner-side new states, routed (emigrant) lanes/bytes,
+#               this shard's share of the wave's work, and its
+#               estimated busy seconds (lockstep SPMD means wall time
+#               is shared; shard_s = compute_s * work_share * D is the
+#               analytic attribution, from which skew = max - median).
+TIMELINE_KEYS = (
+    "event", "wave", "depth", "every", "stages", "wave_s",
+)
+
+MEMWATCH_KEYS = (
+    "event", "wave", "depth", "total_bytes", "peak_bytes",
+    "budget_bytes", "frac", "breakdown",
+)
+
+SHARD_WAVE_KEYS = (
+    "event", "wave", "depth", "shard", "device_count", "new",
+    "routed_lanes", "routed_bytes", "work_share", "shard_s",
+    "exchange_s", "compute_s",
+)
+
 DECLARED_EVENTS = (
     ("manifest", MANIFEST_KEYS),
     ("wave", WAVE_KEYS),
@@ -182,6 +257,9 @@ DECLARED_EVENTS = (
     ("shard_lost", SHARD_LOST_KEYS),
     ("reshard", RESHARD_KEYS),
     ("shard_stall", SHARD_STALL_KEYS),
+    ("timeline", TIMELINE_KEYS),
+    ("memwatch", MEMWATCH_KEYS),
+    ("shard_wave", SHARD_WAVE_KEYS),
 )
 
 EVENT_KEYS = dict(DECLARED_EVENTS)
@@ -242,6 +320,120 @@ def validate_event(ev: object, lineno: int | None = None) -> list[str]:
                 f"{where}wave expand_budget_ovf {bovf!r} must be a "
                 f"non-negative int"
             )
+        for key in ("device_s", "host_s", "ckpt_s", "tel_s"):
+            v = ev.get(key)
+            if v is not None and (
+                isinstance(v, bool) or not isinstance(v, (int, float))
+                or v < 0
+            ):
+                problems.append(
+                    f"{where}wave {key} {v!r} must be a non-negative "
+                    f"number (seconds)"
+                )
+        share = ev.get("exchange_share")
+        if share is not None and (
+            isinstance(share, bool) or not isinstance(share, (int, float))
+            or not 0.0 <= share <= 1.0
+        ):
+            problems.append(
+                f"{where}wave exchange_share {share!r} must be null or a "
+                f"number in [0, 1]"
+            )
+        frac = ev.get("hbm_frac")
+        if frac is not None and (
+            isinstance(frac, bool) or not isinstance(frac, (int, float))
+            or frac < 0
+        ):
+            problems.append(
+                f"{where}wave hbm_frac {frac!r} must be null or a "
+                f"non-negative number"
+            )
+    if etype == "timeline":
+        stages = ev.get("stages")
+        if not isinstance(stages, dict):
+            problems.append(
+                f"{where}timeline stages must be a dict of stage -> "
+                f"seconds, got {type(stages).__name__}"
+            )
+        else:
+            unknown = [s for s in stages if s not in TIMELINE_STAGES]
+            if unknown:
+                problems.append(
+                    f"{where}timeline stage names {unknown} not in the "
+                    f"declared stage set {TIMELINE_STAGES}"
+                )
+            bad = [
+                s for s, v in stages.items()
+                if isinstance(v, bool) or not isinstance(v, (int, float))
+                or v < 0
+            ]
+            if bad:
+                problems.append(
+                    f"{where}timeline stage seconds must be non-negative "
+                    f"numbers (bad: {bad})"
+                )
+        every = ev.get("every")
+        if isinstance(every, bool) or not isinstance(every, int) \
+                or every < 1:
+            problems.append(
+                f"{where}timeline every {every!r} must be an int >= 1 "
+                f"(the sampling stride)"
+            )
+    if etype == "memwatch":
+        for key in ("total_bytes", "peak_bytes", "budget_bytes"):
+            v = ev.get(key)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                problems.append(
+                    f"{where}memwatch {key} {v!r} must be a non-negative "
+                    f"int"
+                )
+        tot, peak = ev.get("total_bytes"), ev.get("peak_bytes")
+        if isinstance(tot, int) and isinstance(peak, int) \
+                and not isinstance(tot, bool) and not isinstance(peak, bool) \
+                and tot > peak:
+            problems.append(
+                f"{where}memwatch total_bytes {tot} exceeds peak_bytes "
+                f"{peak} (the peak must cover the wave that set it)"
+            )
+        br = ev.get("breakdown")
+        if not isinstance(br, dict) or any(
+            not isinstance(k, str) or isinstance(v, bool)
+            or not isinstance(v, int) or v < 0
+            for k, v in br.items()
+        ):
+            problems.append(
+                f"{where}memwatch breakdown must map buffer family "
+                f"names to non-negative int bytes"
+            )
+    if etype == "shard_wave":
+        shard = ev.get("shard")
+        if isinstance(shard, bool) or not isinstance(shard, int) \
+                or shard < 0:
+            problems.append(
+                f"{where}shard_wave shard {shard!r} must be an int >= 0"
+            )
+        dc = ev.get("device_count")
+        if isinstance(dc, bool) or not isinstance(dc, int) or dc < 1:
+            problems.append(
+                f"{where}shard_wave device_count {dc!r} must be an "
+                f"int >= 1"
+            )
+        elif isinstance(shard, int) and not isinstance(shard, bool) \
+                and not 0 <= shard < dc:
+            problems.append(
+                f"{where}shard_wave shard {shard} out of range for "
+                f"device_count {dc}"
+            )
+        for key in ("shard_s", "exchange_s", "compute_s", "work_share"):
+            v = ev.get(key)
+            if v is not None and (
+                isinstance(v, bool) or not isinstance(v, (int, float))
+                or v < 0
+            ):
+                problems.append(
+                    f"{where}shard_wave {key} {v!r} must be a "
+                    f"non-negative number"
+                )
     if etype == "summary" and ev.get("exit_cause") not in EXIT_CAUSES:
         problems.append(
             f"{where}summary exit_cause {ev.get('exit_cause')!r} not in "
@@ -353,6 +545,11 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     strictly increasing within that job's run (its ``job``-tagged
     manifest resets the expectation), and every job manifest must be
     matched by exactly one summary carrying the same job tag.
+
+    Wave-timeline observatory rules: ``timeline`` / ``memwatch`` /
+    ``shard_wave`` events must come before their run's summary, and
+    ``memwatch`` peak_bytes must be monotone non-decreasing within a
+    run (a new manifest resets the watermark).
     """
     counts: dict[str, int] = {}
     problems: list[str] = []
@@ -361,6 +558,7 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
     last_cov_wave = 0
     prev_actions: list | None = None
     last_retry_attempt = 0
+    last_memwatch_peak = 0
     job_wave: dict[str, int] = {}
     job_manifests: dict[str, int] = {}
     job_summaries: dict[str, int] = {}
@@ -385,6 +583,7 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
             summarized = False
             last_cov_wave = 0
             prev_actions = None
+            last_memwatch_peak = 0
             if job is not None:
                 job_manifests[job] = job_manifests.get(job, 0) + 1
                 job_wave[job] = 0
@@ -459,6 +658,23 @@ def validate_lines(lines) -> tuple[dict, list[str]]:
                     f"line {lineno}: {etype} wave index {w} behind the "
                     f"run's last completed wave {last_wave}"
                 )
+        elif etype in ("timeline", "memwatch", "shard_wave"):
+            if summarized:
+                problems.append(
+                    f"line {lineno}: {etype} event after the run's summary"
+                )
+            if etype == "memwatch":
+                peak = ev.get("peak_bytes")
+                if isinstance(peak, int) and not isinstance(peak, bool):
+                    if peak < last_memwatch_peak:
+                        problems.append(
+                            f"line {lineno}: memwatch peak_bytes {peak} "
+                            f"regressed below the run's watermark "
+                            f"{last_memwatch_peak} (peaks are monotone "
+                            f"within a run)"
+                        )
+                    else:
+                        last_memwatch_peak = peak
         elif etype == "retry":
             att = ev.get("attempt")
             if isinstance(att, int) and not isinstance(att, bool):

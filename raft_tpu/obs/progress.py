@@ -38,7 +38,7 @@ class ProgressRenderer:
     # cannot drift apart
     CONSUMES = (
         "depth", "generated_total", "distinct", "distinct_per_s",
-        "canon_memo_hit_rate",
+        "canon_memo_hit_rate", "exchange_share", "hbm_frac",
     )
 
     def __init__(self, every_s: float = 10.0, stream=None):
@@ -47,13 +47,21 @@ class ProgressRenderer:
         self._last: float | None = None
 
     def render_wave(self, ev: dict) -> str:
-        return (
+        line = (
             f"Progress (depth {ev['depth']}): "
             f"{format_count(ev['generated_total'])} generated, "
             f"{format_count(ev['distinct'])} distinct, "
             f"{ev['distinct_per_s']:,.0f}/s, "
             f"memo {ev['canon_memo_hit_rate']:.0%}"
         )
+        # observatory gauges render only when present and non-zero so
+        # the base line (pinned by tests) is unchanged on engines /
+        # waves that don't carry them
+        if ev.get("exchange_share"):
+            line += f", a2a {ev['exchange_share']:.0%}"
+        if ev.get("hbm_frac"):
+            line += f", hbm {ev['hbm_frac']:.0%}"
+        return line
 
     def __call__(self, ev: dict) -> None:
         etype = ev.get("event")

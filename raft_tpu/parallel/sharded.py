@@ -73,7 +73,7 @@ except AttributeError:  # 0.4.x keeps it in experimental; its replication
     _SHARD_MAP_KW = {"check_rep": False}
 
 from ..checker.lsm import CanonMemo, RunLSM, pow2_at_least
-from ..obs import NULL_TELEMETRY
+from ..obs import MemWatch, NULL_TELEMETRY
 from ..obs.events import hashv_of
 from ..checker.util import (
     GROWTH, HEADROOM, I32_MAX, dense_prefix_sel, emit_append,
@@ -228,6 +228,11 @@ class ShardedBFS:
         self.MCAP = self._memo.MCAP
 
         self._chunk_fn_cache: dict[int, object] = {}
+        # wave-timeline observatory: separately dispatched pre / exchange
+        # / post programs for sampled waves (--timeline); the carries
+        # donate exactly as in the fused chunk program.
+        self._tl_pre_ex: tuple | None = None
+        self._tl_post_cache: dict[int, object] = {}
         self._occ_cache: dict[bytes, object] = {}
         self._journals = None  # (jps, jpl, jcand) per shard after run()
         self._init_by_shard = None
@@ -279,6 +284,70 @@ class ShardedBFS:
             self._chunk_fn_cache[n_runs] = fn
         return fn
 
+    def _get_timeline_fns(self, n_runs: int):
+        """The sampled-wave (--timeline) programs: the SAME stage bodies
+        as the fused chunk program, dispatched as three shard_maps —
+        pre (expand..route), exchange (the all-to-all pair), post
+        (dedup..stats) — so the host can block_until_ready between them
+        and attribute real seconds per stage. The loop-carried buffers
+        donate exactly as in the fused program (memo in pre; the nine
+        state carries in post; the routed payloads through exchange):
+        without donation every sampled chunk copies the capacity-shaped
+        frontier/journal buffers through the stage outputs, which
+        dominates the sampled wave on big geometries. The wave loop
+        rebinds every donated carry from the stage returns. The cached
+        occ array and the LSM runs stay undonated (reused across
+        chunks), as does the frontier (read-only within a wave)."""
+        spec = P(AXIS)
+        if self._tl_pre_ex is None:
+            def pre_step(frontier, fcount, memo, cursor, base_lgid):
+                sp, sf, memo2, cg, ps = self._cs_pre(
+                    frontier[0], fcount[0, 0], memo[0], cursor,
+                    base_lgid[0, 0],
+                )
+                return sp[None], sf[None], memo2[None], cg[None], ps[None]
+
+            def ex_step(send_pay, send_fps):
+                rp = lax.all_to_all(send_pay[0], AXIS, 0, 0, tiled=True)
+                rf = lax.all_to_all(send_fps[0], AXIS, 0, 0, tiled=True)
+                return rp[None], rf[None]
+
+            self._tl_pre_ex = (
+                jax.jit(_shard_map(
+                    pre_step, mesh=self.mesh,
+                    in_specs=(spec, spec, spec, P(), spec),
+                    out_specs=(spec,) * 5, **_SHARD_MAP_KW,
+                ), donate_argnums=(2,)),
+                jax.jit(_shard_map(
+                    ex_step, mesh=self.mesh,
+                    in_specs=(spec, spec), out_specs=(spec, spec),
+                    **_SHARD_MAP_KW,
+                ), donate_argnums=(0, 1)),
+            )
+        post_fn = self._tl_post_cache.get(n_runs)
+        if post_fn is None:
+            def post_step(
+                recv_pay, recv_fps, next_buf, jps, jpl, jcand, jfp,
+                viol, stats, cov, cov_gen, pre_stats, occ, *runs,
+            ):
+                out = self._cs_post(
+                    recv_pay[0], recv_fps[0], next_buf[0], jps[0],
+                    jpl[0], jcand[0], jfp[0], viol[0], stats[0], cov[0],
+                    cov_gen[0], pre_stats[0], occ, [r[0] for r in runs],
+                )
+                return tuple(x[None] for x in out)
+
+            # donated: next_buf, jps, jpl, jcand, jfp, viol, stats, cov
+            # (recv_pay/recv_fps can't alias the outputs; occ and the
+            # LSM runs are reused across chunks)
+            post_fn = jax.jit(_shard_map(
+                post_step, mesh=self.mesh,
+                in_specs=(spec,) * 12 + (P(),) + (spec,) * n_runs,
+                out_specs=(spec,) * 9, **_SHARD_MAP_KW,
+            ), donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+            self._tl_post_cache[n_runs] = post_fn
+        return self._tl_pre_ex[0], self._tl_pre_ex[1], post_fn
+
     def _chunk_step(
         self, frontier, fcount, next_buf, jps, jpl, jcand, jfp, viol, stats,
         memo, cov, cursor, occ, base_lgid, *runs,
@@ -300,10 +369,6 @@ class ShardedBFS:
         cum terminal, ovf bits, routed lanes, cum canon memo hits].
         Returns (+ new_run [1,R0]).
         """
-        model, D, A, W = self.model, self.D, self.A, self.W
-        C, VC, RC = self.chunk, self.VC, self.RC
-        F, JC = self.FCAP, self.JCAP
-        K = self.n_actions
         # strip the leading local-block axis shard_map hands us
         frontier, fcount, base_lgid = frontier[0], fcount[0, 0], base_lgid[0, 0]
         next_buf = next_buf[0]
@@ -312,6 +377,38 @@ class ShardedBFS:
         memo = memo[0]
         cov = cov[0]
         runs = [r[0] for r in runs]
+        # composed from the same stage bodies the sampled --timeline
+        # waves dispatch separately (integer-only wave math, so the
+        # fused and staged programs are bit-identical — parity-gated by
+        # tests/test_obs.py)
+        send_pay, send_fps, memo, cov_gen, pre_stats = self._cs_pre(
+            frontier, fcount, memo, cursor, base_lgid
+        )
+        # 5. ICI all-to-all: block d of my send goes to chip d; received
+        # block d came from chip d (=> parent shard = recv row // RC)
+        recv_pay = lax.all_to_all(send_pay, AXIS, 0, 0, tiled=True)
+        recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
+        (next_buf, jps, jpl, jcand, jfp, viol, stats, cov, new_run,
+         ) = self._cs_post(
+            recv_pay, recv_fps, next_buf, jps, jpl, jcand, jfp, viol,
+            stats, cov, cov_gen, pre_stats, occ, runs,
+        )
+        return (
+            next_buf[None], jps[None], jpl[None], jcand[None], jfp[None],
+            viol[None], stats[None], memo[None], cov[None], new_run[None],
+        )
+
+    def _cs_pre(self, frontier, fcount, memo, cursor, base_lgid):
+        """Per-chip pre-exchange stages of one chunk (steps 1-4): expand,
+        compact, canon, owner routing. Returns the all-to-all send blocks
+        plus everything the post stage needs: ``cov_gen`` [K,2] =
+        per-action [enabled, fired] tallied on the generating chip
+        ([1,2] zeros when the model has no action ranks) and
+        ``pre_stats`` [5] i64 = [n_gen, terminal, pre-exchange ovf bits
+        (1=msg 2=valid 4=route), routed lanes, canon memo hits]."""
+        model, D, A, W = self.model, self.D, self.A, self.W
+        C, VC, RC = self.chunk, self.VC, self.RC
+        K = self.n_actions
 
         # 1. expand `chunk` rows starting at the wave cursor
         batch = lax.dynamic_slice(frontier, (cursor, jnp.int32(0)), (C, W))
@@ -411,10 +508,33 @@ class ShardedBFS:
         send_fps = jnp.full((D * RC + 1,), U64_MAX, jnp.uint64).at[slot].set(
             jnp.where(ok, fps_s, U64_MAX))[:-1]
 
-        # 5. ICI all-to-all: block d of my send goes to chip d; received
-        # block d came from chip d (=> parent shard = recv row // RC)
-        recv_pay = lax.all_to_all(send_pay, AXIS, 0, 0, tiled=True)
-        recv_fps = lax.all_to_all(send_fps, AXIS, 0, 0, tiled=True)
+        pre_stats = jnp.stack([
+            n_gen.astype(jnp.int64),
+            term.astype(jnp.int64),
+            expand_ovf.astype(jnp.int64)
+            + 2 * compact_ovf.astype(jnp.int64)
+            + 4 * route_ovf.astype(jnp.int64),
+            n_routed.astype(jnp.int64),
+            n_memo_hit.astype(jnp.int64),
+        ])
+        cov_gen = (
+            jnp.stack([enabled_k, fired_k], axis=1)
+            if K else jnp.zeros((1, 2), jnp.int64)
+        )
+        return send_pay, send_fps, memo, cov_gen, pre_stats
+
+    def _cs_post(
+        self, recv_pay, recv_fps, next_buf, jps, jpl, jcand, jfp, viol,
+        stats, cov, cov_gen, pre_stats, occ, runs,
+    ):
+        """Per-chip post-exchange stages of one chunk (steps 6-8): local
+        dedup against the LSM runs, emit-append, owner-side coverage,
+        invariants, stats fold. ``cov_gen``/``pre_stats`` carry the
+        generating-chip tallies from ``_cs_pre``."""
+        model, D, W = self.model, self.D, self.W
+        RC = self.RC
+        F, JC = self.FCAP, self.JCAP
+        K = self.n_actions
 
         # 6. local dedup: probe the occupied LSM runs + first-occurrence
         rf, sidx = sort_u64_with_idx(recv_fps)
@@ -474,7 +594,8 @@ class ShardedBFS:
                 new.astype(jnp.int64), jnp.where(new, recv_rank, K),
                 num_segments=K + 1,
             )[:K]
-            cov = cov + jnp.stack([enabled_k, fired_k, new_k], axis=1)
+            cov = cov + jnp.concatenate(
+                [cov_gen, new_k[:, None]], axis=1)
         # the chip's new fps as one sorted run (LSM level-0 insert)
         new_run = sort_u64(jnp.where(new, rf, U64_MAX))
         DRC = new_run.shape[0]
@@ -491,9 +612,7 @@ class ShardedBFS:
             viol = viol.at[k].min(jnp.min(jnp.where(bad, jidx, I32_MAX)))
 
         ovf_bits = (
-            expand_ovf.astype(jnp.int64)
-            + 2 * compact_ovf.astype(jnp.int64)
-            + 4 * route_ovf.astype(jnp.int64)
+            pre_stats[2]
             + 8 * frontier_ovf.astype(jnp.int64)
             + 16 * journal_ovf.astype(jnp.int64)
         )
@@ -501,17 +620,14 @@ class ShardedBFS:
             [
                 stats[0] + n_new,
                 stats[1] + n_new,
-                stats[2] + n_gen,
-                stats[3] + term,
+                stats[2] + pre_stats[0],
+                stats[3] + pre_stats[1],
                 stats[4] | ovf_bits,
-                stats[5] + n_routed,
-                stats[6] + n_memo_hit,
+                stats[5] + pre_stats[3],
+                stats[6] + pre_stats[4],
             ]
         )
-        return (
-            next_buf[None], jps[None], jpl[None], jcand[None], jfp[None],
-            viol[None], stats[None], memo[None], cov[None], new_run[None],
-        )
+        return next_buf, jps, jpl, jcand, jfp, viol, stats, cov, new_run
 
     # ---------------- capacity growth (between waves, host-mediated) ------
 
@@ -1205,6 +1321,15 @@ class ShardedBFS:
         memo_prev = 0
         per_shard_memo = np.zeros(D, np.int64)
         wave_times: list[float] = []  # stall-watchdog rolling window
+        # wave-timeline observatory (obs/): sampled waves dispatch the
+        # pre/exchange/post programs separately (bit-identical math);
+        # every wave gets the phase split + analytic HBM watermark
+        tl_every = int(getattr(tel, "timeline_every", 0) or 0)
+        tl_wave_s: list[float] = []
+        fused_wave_s: list[float] = []
+        memwatch = MemWatch(tel) if tel.active else None
+        tel_s_last = 0.0
+        routed_prev_d = np.zeros(D, np.int64)  # per-shard a2a cums
 
         while fcounts.sum() and violation is None:
             if preempt is not None and preempt.requested:
@@ -1252,21 +1377,62 @@ class ShardedBFS:
                 base_lgid.astype(np.int32).reshape(D, 1), self._sharding)
             max_fc = int(fcounts.max())
             chunks_done = 0
+            tl_sample = tl_every > 0 and (depth + 1) % tl_every == 0
+            stage_s = {
+                "expand": 0.0, "exchange": 0.0, "emit": 0.0,
+                "seen_merge": 0.0, "checkpoint": 0.0,
+            }
             with tel.wave_annotation(depth + 1):
                 for cursor in range(0, max_fc, C):
                     occ_dev = self._occ_dev()
-                    chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
-                    (state["next_buf"], state["jps"], state["jpl"],
-                     state["jcand"], state["jfp"], state["viol"],
-                     state["stats"], state["memo"], state["cov"], new_run,
-                     ) = chunk_fn(
-                        state["frontier"], fc_dev, state["next_buf"],
-                        state["jps"], state["jpl"], state["jcand"],
-                        state["jfp"], state["viol"], state["stats"],
-                        state["memo"], state["cov"], np.int32(cursor),
-                        occ_dev, bl_dev, *self._lsm.runs,
-                    )
-                    self._lsm.insert(new_run)
+                    if tl_sample:
+                        pre_fn, ex_fn, post_fn = self._get_timeline_fns(
+                            len(self._lsm.runs))
+                        t1 = time.perf_counter()
+                        (send_pay, send_fps, state["memo"], cov_gen,
+                         pre_stats) = pre_fn(
+                            state["frontier"], fc_dev, state["memo"],
+                            np.int32(cursor), bl_dev,
+                        )
+                        jax.block_until_ready(
+                            (send_pay, send_fps, state["memo"], cov_gen,
+                             pre_stats))
+                        t2 = time.perf_counter()
+                        stage_s["expand"] += t2 - t1
+                        recv_pay, recv_fps = ex_fn(send_pay, send_fps)
+                        jax.block_until_ready((recv_pay, recv_fps))
+                        t3 = time.perf_counter()
+                        stage_s["exchange"] += t3 - t2
+                        (state["next_buf"], state["jps"], state["jpl"],
+                         state["jcand"], state["jfp"], state["viol"],
+                         state["stats"], state["cov"], new_run,
+                         ) = post_fn(
+                            recv_pay, recv_fps, state["next_buf"],
+                            state["jps"], state["jpl"], state["jcand"],
+                            state["jfp"], state["viol"], state["stats"],
+                            state["cov"], cov_gen, pre_stats, occ_dev,
+                            *self._lsm.runs,
+                        )
+                        jax.block_until_ready(new_run)
+                        t4 = time.perf_counter()
+                        stage_s["emit"] += t4 - t3
+                        self._lsm.insert(new_run)
+                        jax.block_until_ready(self._lsm.runs)
+                        stage_s["seen_merge"] += time.perf_counter() - t4
+                    else:
+                        chunk_fn = self._get_chunk_fn(len(self._lsm.runs))
+                        (state["next_buf"], state["jps"], state["jpl"],
+                         state["jcand"], state["jfp"], state["viol"],
+                         state["stats"], state["memo"], state["cov"],
+                         new_run,
+                         ) = chunk_fn(
+                            state["frontier"], fc_dev, state["next_buf"],
+                            state["jps"], state["jpl"], state["jcand"],
+                            state["jfp"], state["viol"], state["stats"],
+                            state["memo"], state["cov"], np.int32(cursor),
+                            occ_dev, bl_dev, *self._lsm.runs,
+                        )
+                        self._lsm.insert(new_run)
                     chunks_done += 1
                     if chaos is not None:
                         lost = chaos.shard_loss(depth + 1, D)
@@ -1363,6 +1529,11 @@ class ShardedBFS:
                         checkpoint_saved=saved,
                     )
             wave_times.append(wave_s_now)
+            # phase split: everything up to the stats fetch is device-
+            # blocked time; checkpoint I/O is bracketed below; the
+            # residual (growth, LSM bookkeeping) lands in host_s
+            device_s = wave_s_now
+            ckpt_s = 0.0
             # commit only after the ovf check: an aborted wave keeps the
             # wave-start counters (consistent with what a checkpoint saved)
             cov_hd = np.asarray(cov_w, dtype=np.int64)
@@ -1374,6 +1545,8 @@ class ShardedBFS:
             terminal = int(stats_h[:, 3].sum())
             wave_routed = int(stats_h[:, 5].sum()) - routed_prev
             routed_prev = int(stats_h[:, 5].sum())
+            wave_routed_d = stats_h[:, 5] - routed_prev_d
+            routed_prev_d = stats_h[:, 5].copy()
             memo_hits = int(stats_h[:, 6].sum())
             wave_memo = memo_hits - memo_prev
             memo_prev = memo_hits
@@ -1416,6 +1589,7 @@ class ShardedBFS:
                     checkpoint_path is not None
                     and time.perf_counter() - last_ckpt > checkpoint_every_s
                 ):
+                    t_ck = time.perf_counter()
                     with tel.annotate("checkpoint"):
                         self._save_checkpoint(
                             checkpoint_path, state, fcounts, scounts,
@@ -1426,8 +1600,32 @@ class ShardedBFS:
                             cov_hd,
                         )
                     last_ckpt = time.perf_counter()
+                    ckpt_s = last_ckpt - t_ck
+                    stage_s["checkpoint"] += ckpt_s
+            wave_s_val = time.perf_counter() - tw
+            if tl_every:
+                (tl_wave_s if tl_sample else fused_wave_s).append(wave_s_val)
             if tel.active or metrics is not None or verbose:
                 el = time.perf_counter() - t0
+                hbm_frac = None
+                if memwatch is not None:
+                    # PER-CHIP analytic live bytes (the budget is one
+                    # core's HBM): double-buffered frontier, 4-lane
+                    # journal, this chip's LSM lanes, the chunk scratch
+                    # (payload + send/recv blocks), the canon memo
+                    frac = memwatch.update(depth, depth, {
+                        "frontier": 2 * (self.FCAP + self.EPAD) * 4 * W,
+                        "journal": (self.JCAP + self.EPAD) * (4 * 3 + 8),
+                        "seen": int(self._lsm.lanes()) * 8,
+                        "chunk": (self.VC + 2 * self.D * self.RC)
+                        * (4 * (W + 3) + 8),
+                        "memo": self.MCAP * 16 if self._use_memo else 0,
+                    })
+                    hbm_frac = round(frac, 6)
+                tl_dev = (
+                    stage_s["expand"] + stage_s["exchange"]
+                    + stage_s["emit"]
+                )
                 wm = {
                     "depth": depth,
                     "frontier": int(prev_fcounts.sum()),
@@ -1442,9 +1640,21 @@ class ShardedBFS:
                         wave_memo / max(1, wave_gen), 4
                     ),
                     "overflow_bits": ovf_bits,
-                    "wave_s": round(time.perf_counter() - tw, 3),
+                    "wave_s": round(wave_s_val, 3),
                     "elapsed_s": round(el, 3),
                     "distinct_per_s": round(distinct / el, 1),
+                    "device_s": round(device_s, 4),
+                    "host_s": round(
+                        max(0.0, wave_s_val - device_s - ckpt_s), 4),
+                    "ckpt_s": round(ckpt_s, 4),
+                    "tel_s": round(tel_s_last, 4),
+                    # exchange share of the sampled wave's staged device
+                    # seconds; null on fused (unsampled) waves — the
+                    # fused program cannot separate the all-to-all
+                    "exchange_share": round(
+                        stage_s["exchange"] / tl_dev, 4)
+                    if tl_sample and tl_dev > 0 else None,
+                    "hbm_frac": hbm_frac,
                     "a2a_lanes": wave_routed,
                     # payload widened to W+3 by the routed rank column
                     "a2a_bytes": wave_routed * (4 * (W + 3) + 8),
@@ -1471,10 +1681,40 @@ class ShardedBFS:
                     ),
                     "expand_budget_ovf": (ovf_bits >> 1) & 1,
                 }
+                t_tel = time.perf_counter()
                 tel.wave(wm)
                 if tel.active:
                     tel.coverage(self._coverage_fields(
                         depth, cov_hd, scounts, depth_counts))
+                    if tl_sample:
+                        tel.event(
+                            "timeline", wave=depth, depth=depth,
+                            every=tl_every,
+                            stages={
+                                k: round(v, 5)
+                                for k, v in stage_s.items() if v > 0
+                            },
+                            wave_s=round(wave_s_val, 4),
+                        )
+                        # per-shard critical-path rows: lockstep SPMD
+                        # shares the wall clock, so shard_s is the
+                        # analytic attribution compute_s*work_share*D
+                        # (skew = max - median over shards)
+                        comp_s = stage_s["expand"] + stage_s["emit"]
+                        for d in range(D):
+                            ws = int(new_d[d]) / max(1, global_new)
+                            tel.event(
+                                "shard_wave", wave=depth, depth=depth,
+                                shard=d, device_count=D,
+                                new=int(new_d[d]),
+                                routed_lanes=int(wave_routed_d[d]),
+                                routed_bytes=int(wave_routed_d[d])
+                                * (4 * (W + 3) + 8),
+                                work_share=round(ws, 4),
+                                shard_s=round(comp_s * ws * D, 5),
+                                exchange_s=round(stage_s["exchange"], 5),
+                                compute_s=round(comp_s, 5),
+                            )
                 if metrics is not None:
                     metrics.append(wm)
                 if verbose:
@@ -1484,6 +1724,7 @@ class ShardedBFS:
                         f"balance={new_d.min()}/{new_d.max()} "
                         f"({distinct/el:.0f} distinct/s)",
                         file=sys.stderr)
+                tel_s_last = time.perf_counter() - t_tel
 
         if (checkpoint_path is not None and violation is None
                 and not exhausted):
@@ -1533,6 +1774,21 @@ class ShardedBFS:
             cf = self._coverage_fields(depth, cov_hd, scounts, depth_counts)
             cf["canon_memo_fill"] = memo_fill
             tel.coverage(cf, final=True)
+        tl_extras = {}
+        if tl_every:
+            mt = sum(tl_wave_s) / len(tl_wave_s) if tl_wave_s else None
+            mf = (
+                sum(fused_wave_s) / len(fused_wave_s)
+                if fused_wave_s else None
+            )
+            tl_extras = {
+                "timeline_every": tl_every,
+                "timeline_waves": len(tl_wave_s),
+                # per-wave extra cost of the staged dispatches,
+                # amortized over the stride
+                "timeline_overhead": round((mt - mf) / (mf * tl_every), 4)
+                if mt is not None and mf else None,
+            }
         tel.close_run({
             "engine": "sharded",
             "ident": self._ckpt_ident(),
@@ -1552,6 +1808,8 @@ class ShardedBFS:
             # sharded extras (schema allows extra keys)
             "shard_memo_hits": fleet_stats["shard_memo_hits"],
             "shard_skew": fleet_stats["shard_skew"],
+            **tl_extras,
+            **(memwatch.summary_fields() if memwatch is not None else {}),
         })
         trace = init_trace
         if violation is not None and viol_site is not None:

@@ -251,6 +251,18 @@ def main():
           "stream instead (`--progress` / `--metrics-out`; README",
           "\"Observability\").",
           "",
+          "The live counterpart of THIS table is the wave-timeline",
+          "observatory (`--timeline[=EVERY_N]`, `timeline` events,",
+          "rendered by `scripts/obs_report.py`): every Nth wave of a",
+          "real run is re-dispatched as separately timed stages, so its",
+          "stage shares include the cross-stage effects isolation hides",
+          "(cache reuse, host overlap, real frontier mix). Trust THIS",
+          "file for per-stage isolation — which kernel is slow and why;",
+          "trust the timeline shares for where a real run's wall clock",
+          "actually goes. When the two disagree, the gap itself is the",
+          "finding (usually dispatch overlap or a frontier mix the",
+          "offline workloads don't reproduce).",
+          "",
           f"Device: {results['meta']['device']} "
           f"({results['meta']['when']}). Produced by "
           "`python scripts/profile_workloads.py`; stage semantics in "

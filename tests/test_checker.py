@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+from pathlib import Path
+
 from raft_tpu.checker.bfs import BFSChecker
 from raft_tpu.models.raft import RaftModel, RaftParams, cached_model
 from raft_tpu.oracle.raft_oracle import RaftOracle
@@ -49,6 +51,10 @@ def test_bfs_counts_match_oracle_with_restarts():
     assert res.total == ores["total"]
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference").exists(),
+    reason="reference TLA+ spec tree not checked out at /root/reference",
+)
 def test_cfg_parse_reference_raft():
     from raft_tpu.utils.cfg import parse_cfg
     from raft_tpu.models.registry import build_from_cfg

@@ -23,6 +23,7 @@ from raft_tpu.obs import (
     MANIFEST_KEYS,
     STALL_KEYS,
     SUMMARY_KEYS,
+    TIMELINE_STAGES,
     WAVE_KEYS,
     MetricsCollector,
     ProgressRenderer,
@@ -200,6 +201,7 @@ def test_schema_and_renderer_stay_in_sync():
         "manifest", "wave", "stall", "coverage", "summary",
         "retry", "resume", "ckpt_generation", "preempt",
         "shard_lost", "reshard", "shard_stall",
+        "timeline", "memwatch", "shard_wave",
     )
     for _, keys in DECLARED_EVENTS:
         assert keys[0] == "event"
@@ -351,6 +353,316 @@ def test_sharded_stream_and_fleet_stats(tmp_path):
     ]
 
 
+# ------------------------------------------ wave-timeline observatory
+
+
+def test_timeline_sampled_waves_bit_identical_device(tmp_path):
+    """The tentpole contract on the device engine: --timeline re-runs
+    every Nth wave as separately timed stage dispatches that compute
+    bit-identical counts, and the stream carries the new events."""
+    eng = _device()
+    bare = eng.run(max_depth=5)
+
+    path = tmp_path / "tl.jsonl"
+    with Telemetry(metrics_path=str(path), timeline_every=2) as tel:
+        res = eng.run(max_depth=5, telemetry=tel)
+
+    assert res.distinct == bare.distinct
+    assert res.total == bare.total
+    assert res.terminal == bare.terminal
+    assert res.depth_counts == bare.depth_counts
+
+    with open(path) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+    assert counts["timeline"] >= 2
+    assert counts["memwatch"] >= 1
+
+    tls = [e for e in tel.events if e["event"] == "timeline"]
+    for tl in tls:
+        assert tl["every"] == 2
+        assert set(tl["stages"]) <= set(TIMELINE_STAGES)
+        assert sum(tl["stages"].values()) > 0
+        assert tl["wave_s"] >= 0
+
+    # every wave (sampled or not) carries the host-side phase split
+    for w in tel.wave_events():
+        for k in ("device_s", "host_s", "ckpt_s", "tel_s"):
+            assert isinstance(w[k], (int, float)), k
+            assert w[k] >= 0, k
+
+    s = tel.last_summary
+    assert s["timeline_every"] == 2
+    assert s["timeline_waves"] == len(tls)
+    assert s["hbm_peak_bytes"] > 0
+    assert 0 < s["hbm_peak_frac"] < 1
+
+
+def _small_kraft():
+    from raft_tpu.models.kraft import KRaftParams
+    from raft_tpu.models.kraft import cached_model as kraft_model
+
+    return kraft_model(KRaftParams(
+        n_servers=3, n_values=1, max_elections=1, max_restarts=0,
+        msg_slots=40,
+    ))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("which", ["raft", "kraft"])
+def test_timeline_parity_all_engines(which):
+    """Sampled-wave bit-identity across the full engine matrix (2
+    models x host/device/sharded) — the staged dispatch must never
+    change what gets checked."""
+    import jax
+
+    from raft_tpu.checker.bfs import BFSChecker
+    from raft_tpu.checker.device_bfs import DeviceBFS
+    from raft_tpu.parallel.sharded import ShardedBFS
+
+    if which == "raft":
+        model, invs = cached_model(SMALL), INVS
+    else:
+        model = _small_kraft()
+        invs = ("LeaderHasAllAckedValues", "NoLogDivergence",
+                "NeverTwoLeadersInSameEpoch", "NoIllegalState")
+
+    factories = {
+        "host": lambda: BFSChecker(
+            model, invariants=invs, symmetry=True, chunk=256),
+        "device": lambda: DeviceBFS(
+            model, invariants=invs, symmetry=True, chunk=256,
+            frontier_cap=1 << 12, seen_cap=1 << 15, journal_cap=1 << 15),
+        "sharded": lambda: ShardedBFS(
+            model, invariants=invs, symmetry=True,
+            devices=jax.devices()[:2], chunk=512, frontier_cap=2048,
+            seen_cap=1 << 13),
+    }
+    for name, make in factories.items():
+        bare = make().run(max_depth=5)
+        tel = Telemetry(timeline_every=2)
+        res = make().run(max_depth=5, telemetry=tel)
+        assert res.distinct == bare.distinct, (which, name)
+        assert res.total == bare.total, (which, name)
+        assert res.depth_counts == bare.depth_counts, (which, name)
+        tls = [e for e in tel.events if e["event"] == "timeline"]
+        assert tls, (which, name)
+        assert all(set(t["stages"]) <= set(TIMELINE_STAGES) for t in tls)
+
+
+@pytest.mark.slow
+def test_sharded_timeline_shard_wave_events(tmp_path):
+    """Sharded D=2: sampled waves emit one shard_wave row per shard
+    with work shares in [0,1]; the exchange-share gauge lands on the
+    sampled wave events; obs_report renders the critical-path table."""
+    import jax
+
+    from raft_tpu.parallel.sharded import ShardedBFS
+
+    path = tmp_path / "sw.jsonl"
+    eng = ShardedBFS(
+        cached_model(SMALL), invariants=INVS, symmetry=True,
+        devices=jax.devices()[:2], chunk=512, frontier_cap=1024,
+        seen_cap=1 << 12,
+    )
+    with Telemetry(metrics_path=str(path), timeline_every=2) as tel:
+        eng.run(max_depth=6, telemetry=tel)
+
+    with open(path) as fh:
+        counts, problems = validate_lines(fh)
+    assert not problems, problems
+
+    tls = [e for e in tel.events if e["event"] == "timeline"]
+    sws = [e for e in tel.events if e["event"] == "shard_wave"]
+    assert tls and len(sws) == 2 * len(tls)  # one row per shard per sample
+    by_wave: dict[int, list[dict]] = {}
+    for sw in sws:
+        assert sw["device_count"] == 2
+        assert 0 <= sw["shard"] < 2
+        assert 0.0 <= sw["work_share"] <= 1.0
+        assert sw["routed_lanes"] >= 0 and sw["routed_bytes"] >= 0
+        by_wave.setdefault(sw["wave"], []).append(sw)
+    for wave, rows in by_wave.items():
+        assert sorted(r["shard"] for r in rows) == [0, 1]
+        if sum(r["new"] for r in rows) > 0:
+            assert sum(r["work_share"] for r in rows) == pytest.approx(
+                1.0, abs=0.01), wave
+
+    shares = [
+        w["exchange_share"] for w in tel.wave_events()
+        if w["exchange_share"] is not None
+    ]
+    assert shares and all(0.0 <= s <= 1.0 for s in shares)
+
+    from scripts.obs_report import render_run, split_runs
+
+    with open(path) as fh:
+        text = render_run(split_runs(fh)[-1])
+    assert "Shard critical path" in text
+    assert "shard skew" in text
+    assert "Wave timeline" in text
+    assert "Memory watermarks" in text
+
+
+def test_progress_renderer_observatory_gauges():
+    ev = dict.fromkeys(WAVE_KEYS, 0)
+    ev.update(event="wave", depth=7, generated_total=100, distinct=50,
+              distinct_per_s=10.0, canon_memo_hit_rate=0.5,
+              exchange_share=0.25, hbm_frac=0.5)
+    line = ProgressRenderer().render_wave(ev)
+    assert line.endswith(", a2a 25%, hbm 50%")
+    # null/zero gauges leave the pinned base line untouched
+    ev.update(exchange_share=None, hbm_frac=0)
+    assert ProgressRenderer().render_wave(ev).endswith("memo 50%")
+
+
+# ---------------------------------------- observatory schema fixtures
+
+
+def _observatory_stream(tmp_path, name="obs.jsonl"):
+    """One schema-clean stream exercising all three new events."""
+    path = tmp_path / name
+    c = MetricsCollector(path=str(path))
+    c.manifest(_fields(MANIFEST_KEYS, ident="x/hashv=5"))
+    c.wave(_wave(0, 0.5))
+    c.event("timeline", wave=1, depth=0, every=2,
+            stages={"expand": 0.1, "emit": 0.05}, wave_s=0.5)
+    c.event("memwatch", wave=1, depth=0, total_bytes=100, peak_bytes=100,
+            budget_bytes=1000, frac=0.1, breakdown={"frontier": 60, "seen": 40})
+    c.event("shard_wave", wave=1, depth=0, shard=1, device_count=2, new=5,
+            routed_lanes=3, routed_bytes=120, work_share=0.5, shard_s=0.2,
+            exchange_s=0.01, compute_s=0.2)
+    c.wave(_wave(1, 0.4))
+    c.event("memwatch", wave=2, depth=1, total_bytes=150, peak_bytes=200,
+            budget_bytes=1000, frac=0.2, breakdown={"frontier": 150})
+    c.summary(_fields(SUMMARY_KEYS, exit_cause="exhausted"))
+    c.close()
+    return path
+
+
+def _perturb(path, tmp_path, match, repl, name):
+    lines = path.read_text().splitlines()
+    hits = [i for i, ln in enumerate(lines) if match in ln]
+    assert hits, match
+    lines[hits[0]] = lines[hits[0]].replace(match, repl)
+    bad = tmp_path / name
+    bad.write_text("\n".join(lines) + "\n")
+    return bad
+
+
+def test_observatory_fixture_positive(tmp_path):
+    from scripts.check_metrics_schema import validate_file
+
+    good = _observatory_stream(tmp_path)
+    counts, problems = validate_file(str(good))
+    assert not problems, problems
+    assert counts["timeline"] == 1
+    assert counts["memwatch"] == 2
+    assert counts["shard_wave"] == 1
+
+
+def test_observatory_fixture_bad_stage_name(tmp_path):
+    from scripts.check_metrics_schema import validate_file
+
+    good = _observatory_stream(tmp_path)
+    bad = _perturb(good, tmp_path, '"expand"', '"quux"', "bad_stage.jsonl")
+    _, problems = validate_file(str(bad))
+    assert any("stage names" in p and "quux" in p for p in problems), problems
+
+
+def test_observatory_fixture_nonmonotone_peak(tmp_path):
+    from scripts.check_metrics_schema import validate_file
+
+    good = _observatory_stream(tmp_path)
+    # second memwatch peak drops below the first: 200 -> 50
+    bad = _perturb(good, tmp_path, '"peak_bytes": 200', '"peak_bytes": 50',
+                   "bad_peak.jsonl")
+    # keep total <= peak so ONLY the monotonicity rule fires
+    bad.write_text(bad.read_text().replace('"total_bytes": 150',
+                                           '"total_bytes": 50'))
+    _, problems = validate_file(str(bad))
+    assert any("monotone" in p for p in problems), problems
+
+
+def test_observatory_fixture_shard_out_of_range(tmp_path):
+    from scripts.check_metrics_schema import validate_file
+
+    good = _observatory_stream(tmp_path)
+    bad = _perturb(good, tmp_path, '"shard": 1', '"shard": 2',
+                   "bad_shard.jsonl")
+    _, problems = validate_file(str(bad))
+    assert any("out of range" in p for p in problems), problems
+
+
+# ------------------------------------------------------------ bench gate
+
+
+def test_bench_gate_evaluate():
+    from scripts.bench_gate import evaluate
+
+    summ = {"event": "summary", "distinct": 31, "total": 40, "depth": 4,
+            "terminal": 0, "seconds": 10.0}
+    base = {"metrics": {
+        "distinct": {"value": 31, "direction": "eq"},
+        "seconds": {"value": 8.0, "rel_tol": 0.5, "direction": "max"},
+    }}
+    v = evaluate(summ, base)
+    assert v["pass"] and v["checked"] == 2 and not v["failures"]
+
+    tight = {"metrics": {"distinct": {"value": 25, "direction": "eq"}}}
+    v2 = evaluate(summ, tight)
+    assert not v2["pass"]
+    assert "distinct" in v2["failures"][0]
+
+    # a gated metric missing from the summary fails, never skips
+    v3 = evaluate(summ, {"metrics": {"nope": {"value": 1}}})
+    assert not v3["pass"] and "missing" in v3["failures"][0]
+
+    # min direction: smaller is worse
+    v4 = evaluate(summ, {"metrics": {
+        "seconds": {"value": 20.0, "rel_tol": 0.1, "direction": "min"}}})
+    assert not v4["pass"]
+
+    # malformed baselines raise (exit 64 at the CLI), distinct from fail
+    for bad in (
+        {"metrics": {}},
+        {"metrics": {"x": {"value": 1, "tol": 1, "rel_tol": 1}}},
+        {"metrics": {"x": {"value": 1, "direction": "sideways"}}},
+        {"metrics": {"x": {}}},
+    ):
+        with pytest.raises(ValueError):
+            evaluate(summ, bad)
+
+
+def test_bench_gate_script_exit_codes(tmp_path, capsys):
+    from scripts.bench_gate import main as gate_main
+
+    summ = {"event": "summary", "distinct": 31, "depth": 4}
+    m = tmp_path / "m.jsonl"
+    m.write_text(json.dumps(summ) + "\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"metrics": {
+        "distinct": {"value": 31, "direction": "eq"}}}))
+    assert gate_main([str(m), str(base)]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["pass"] is True and verdict["checked"] == 1
+
+    tight = tmp_path / "tight.json"
+    tight.write_text(json.dumps({"metrics": {
+        "distinct": {"value": 25, "direction": "eq"}}}))
+    assert gate_main([str(m), str(tight)]) == 3
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["pass"] is False
+    assert "GATE FAIL" in cap.err
+
+    assert gate_main([str(tmp_path / "nope.jsonl"), str(base)]) == 66
+    capsys.readouterr()
+    broken = tmp_path / "broken.json"
+    broken.write_text("{not json")
+    assert gate_main([str(m), str(broken)]) == 64
+    capsys.readouterr()
+
+
 # ----------------------------------------------------------------- CLI
 
 
@@ -432,3 +744,57 @@ def test_cli_json_progress_and_bit_identical_result(tmp_path, capsys):
     # wall-clock fields differ run to run; the counts must not
     strip = lambda s: s.split(" time=")[0]  # noqa: E731
     assert strip(bare_line) == strip(result_line)
+
+
+CFG3 = CFG.replace("    v1 = v1", "    n3 = n3\n    v1 = v1").replace(
+    "Server = { n1, n2 }", "Server = { n1, n2, n3 }")
+
+
+def test_cli_timeline_smoke_and_bench_gate(tmp_path, capsys):
+    """Tier-1 smoke of the whole observatory loop: a depth-4 3-server
+    Raft CLI check under --timeline=2 produces a schema-clean stream
+    that PASSES the committed bench_gate baseline, while a 20%-tighter
+    baseline fails with the strict-gate exit code 3."""
+    from pathlib import Path
+
+    from raft_tpu.__main__ import main
+    from scripts.bench_gate import main as gate_main
+    from scripts.check_metrics_schema import validate_file
+
+    cfg = tmp_path / "Raft.cfg"
+    cfg.write_text(CFG3)
+    mpath = tmp_path / "tl.jsonl"
+
+    rc = main([str(cfg), *CLI_BASE, "--timeline=2",
+               "--metrics-out", str(mpath)])
+    cap = capsys.readouterr()
+    assert rc == 0, cap.err
+
+    counts, problems = validate_file(str(mpath))
+    assert not problems, problems
+    assert counts["wave"] == 4
+    assert counts["timeline"] == 2  # waves at depth 1 and 3
+    assert counts["memwatch"] >= 1
+
+    with open(mpath) as fh:
+        summ = json.loads(fh.read().strip().splitlines()[-1])
+    assert summ["event"] == "summary"
+    assert summ["timeline_every"] == 2
+    assert summ["timeline_waves"] == 2
+    assert summ["hbm_peak_bytes"] > 0
+
+    golden = Path(__file__).parent / "golden" / "raft3_depth4_gate.json"
+    assert gate_main([str(mpath), str(golden)]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["pass"] is True and verdict["checked"] >= 4
+
+    # tighten every eq count by 20%: a regression gate that cannot
+    # fail is no gate — pin the exit-3 path on the same stream
+    base = json.loads(golden.read_text())
+    base["metrics"]["distinct"]["value"] = round(
+        base["metrics"]["distinct"]["value"] * 0.8)
+    tight = tmp_path / "tight.json"
+    tight.write_text(json.dumps(base))
+    assert gate_main([str(mpath), str(tight)]) == 3
+    cap = capsys.readouterr()
+    assert "GATE FAIL distinct" in cap.err

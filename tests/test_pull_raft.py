@@ -6,6 +6,8 @@ with the documented `v2` diagnosis (PullRaft.cfg:9-11)."""
 import numpy as np
 import pytest
 
+from pathlib import Path
+
 import jax
 
 from raft_tpu.checker.bfs import BFSChecker
@@ -128,6 +130,10 @@ def test_pull_flow_reaches_commit():
     assert st["acked"][0] is True
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference").exists(),
+    reason="reference TLA+ spec tree not checked out at /root/reference",
+)
 def test_reference_pull_cfgs_load_with_diagnosis():
     from raft_tpu.utils.cfg import CfgError, parse_cfg
     from raft_tpu.models.registry import build_from_cfg

@@ -5,6 +5,8 @@ loading (raft-and-fsync/RaftFsync.tla + RaftFsync.cfg)."""
 import numpy as np
 import pytest
 
+from pathlib import Path
+
 import jax
 
 from raft_tpu.checker.bfs import BFSChecker
@@ -105,6 +107,10 @@ def test_fsync_restart_truncates_to_fsync_index():
         assert oracle.serialize_full(got) == oracle.serialize_full(want)
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference").exists(),
+    reason="reference TLA+ spec tree not checked out at /root/reference",
+)
 def test_reference_fsync_cfg_loads():
     from raft_tpu.utils.cfg import parse_cfg
     from raft_tpu.models.registry import build_from_cfg

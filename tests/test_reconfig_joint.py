@@ -6,6 +6,8 @@ loading."""
 import numpy as np
 import pytest
 
+from pathlib import Path
+
 import jax
 
 from raft_tpu.checker.bfs import BFSChecker
@@ -179,6 +181,10 @@ def test_adjacency_invariant_detects_bad_log():
     assert ok.tolist() == [True, False]
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference").exists(),
+    reason="reference TLA+ spec tree not checked out at /root/reference",
+)
 def test_reference_joint_cfg_loads():
     from raft_tpu.utils.cfg import parse_cfg
     from raft_tpu.models.registry import build_from_cfg

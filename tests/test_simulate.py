@@ -4,6 +4,8 @@ semantics, violation detection with trace replay, CLI integration."""
 import numpy as np
 import pytest
 
+from pathlib import Path
+
 import jax
 import jax.numpy as jnp
 
@@ -66,6 +68,10 @@ def test_simulation_finds_planted_violation_and_replays():
         del model.invariants["NoLeaderEver"]
 
 
+@pytest.mark.skipif(
+    not Path("/root/reference").exists(),
+    reason="reference TLA+ spec tree not checked out at /root/reference",
+)
 def test_simulate_cli_on_flexible_raft_cfg():
     """FlexibleRaft.cfg:5 prescribes simulation mode; drive it through
     the CLI entry point (in-process)."""
