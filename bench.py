@@ -268,6 +268,46 @@ def main():
     setup = build_from_cfg(cfg, msg_slots=32)
     model, invs = setup.model, setup.invariants
 
+    # 00. kernel contract audit (raft_tpu lint --strict, in-process):
+    # the BENCH row records the static-analysis verdict as provenance,
+    # and a dirty strict verdict refuses publication BEFORE any wave
+    # runs — mirroring the BENCH_GATE_BASELINE pattern: the bench
+    # stays a measurement, the contract verdict travels with it.
+    # RAFT_TPU_BENCH_NO_LINT=1 opts out (e.g. a deliberately mutated
+    # tree under study).
+    lint_row = None
+    if os.environ.get("RAFT_TPU_BENCH_NO_LINT") != "1":
+        from raft_tpu.analysis.cli import lint_verdict
+
+        try:
+            lv = lint_verdict(strict=True)
+        except Exception as e:  # a crashed auditor is not a clean one
+            lv = {"clean": False, "strict": True,
+                  "error": f"{type(e).__name__}: {e}"}
+        lint_row = {
+            k: lv[k]
+            for k in ("strict", "errors", "warnings", "checked",
+                      "clean", "error")
+            if k in lv
+        }
+        if not lv.get("clean"):
+            findings = [
+                f"[{p['pass']}] {f['file']}:{f['line']} {f['message']}"
+                for p in lv.get("passes", ())
+                for f in p.get("findings", ())
+            ]
+            print(json.dumps({
+                "metric": "distinct_states_per_sec_raft3_cfg",
+                "value": 0,
+                "unit": "distinct states/s",
+                "vs_baseline": None,
+                "error": "strict lint FAILED: kernel contract findings "
+                         "refuse publication (RAFT_TPU_BENCH_NO_LINT=1 "
+                         "to override)",
+                "detail": {"lint": lint_row, "findings": findings[:10]},
+            }))
+            return 1
+
     # live telemetry for the headline run: the JSONL stream is the
     # benchmark's provenance record (manifest = engine geometry + device;
     # wave events = the trajectory below), schema-checked after the run
@@ -433,6 +473,7 @@ def main():
                 "problems": metrics_problems[:5],
             },
             "bench_gate": bench_gate_verdict,
+            "lint": lint_row,
             "same_depth_cmp": {
                 "depth": cmp_depth,
                 "distinct": tpu_cmp.distinct,

@@ -9,9 +9,10 @@ selects the backend; `oracle` is the pure-Python differential reference.
 
 Exit codes (stable contract, pinned by tests/test_resilience.py):
 
-    0   clean run, no violations
+    0   clean run, no violations (also: `lint` found no findings)
     2   invariant or temporal-property violation found
-    3   --coverage=strict dead-action gate tripped
+    3   --coverage=strict dead-action gate tripped; `lint` findings
+        (any error, or any warning under --strict)
     4   preempted (SIGTERM/SIGINT): a resumable checkpoint was written
         at the next wave boundary; re-run with --resume to continue
     5   unrecoverable failure (retry budget spent, capacity overflow
@@ -36,6 +37,12 @@ def main(argv=None):
         from .fleet.cli import sweep_main
 
         return sweep_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # static-analysis subcommand: `raft_tpu lint [--strict] [--json]
+        # [--pass NAME] [--mutate NAME]` (analysis/cli.py)
+        from .analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     ap = argparse.ArgumentParser(prog="raft_tpu")
     ap.add_argument("cfg", help="TLC .cfg file (the spec is inferred from its name)")
     ap.add_argument("--spec", help="spec/module name override")

@@ -1,0 +1,110 @@
+"""Events-drift check: the metrics schema's three sources of truth —
+``DECLARED_EVENTS``, the validator branches, and the dependency-free
+``scripts/check_metrics_schema.py`` contract doc — cannot drift apart.
+
+``obs/events.py`` declares the event vocabulary (``DECLARED_EVENTS``
+-> ``EVENT_KEYS``) and validates structurally per type;
+``scripts/check_metrics_schema.py`` is the CI-facing wrapper whose
+module docstring IS the published schema contract. Three drift modes,
+each caught here:
+
+  * a validator branch tests an event type that is no longer declared
+    (stale branch: dead validation that reads as coverage) — error;
+  * a declared type has no mention in the schema script's contract doc
+    (the doc silently under-promises; consumers building on the doc
+    miss the event) — warning, gates under --strict;
+  * a documented-looking type in a validator membership test that the
+    declaration table dropped — same error as the first mode.
+
+Mentions are matched on WORD BOUNDARIES: "shard_stall" must not mask a
+missing "stall" entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import time
+
+from .findings import Finding, PassResult, rel
+
+PASS_ID = "events-drift"
+
+SCHEMA_SCRIPT = os.path.join("scripts", "check_metrics_schema.py")
+EVENTS_MODULE = os.path.join("raft_tpu", "obs", "events.py")
+
+
+def branch_literals(src: str):
+    """String literals the validators compare an event type against:
+    ``etype == "wave"`` / ``etype in ("resume", ...)`` patterns inside
+    ``validate_event`` / ``validate_lines``. Returns {literal: line}."""
+    tree = ast.parse(src)
+    out: dict[str, int] = {}
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, ast.FunctionDef)
+                and fn.name in ("validate_event", "validate_lines")):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not (isinstance(node.left, ast.Name)
+                    and node.left.id == "etype"):
+                continue
+            for op, cmp in zip(node.ops, node.comparators):
+                if isinstance(op, ast.Eq) and isinstance(
+                        cmp, ast.Constant) and isinstance(cmp.value, str):
+                    out.setdefault(cmp.value, node.lineno)
+                elif isinstance(op, ast.In) and isinstance(cmp, ast.Tuple):
+                    for e in cmp.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                                e.value, str):
+                            out.setdefault(e.value, node.lineno)
+    return out
+
+
+def missing_doc_mentions(doc: str, declared) -> list[str]:
+    """Declared event types with no word-boundary mention in ``doc``."""
+    return sorted(
+        t for t in declared
+        if not re.search(rf"\b{re.escape(t)}\b", doc)
+    )
+
+
+def run() -> PassResult:
+    from .findings import REPO_ROOT
+    from ..obs.events import EVENT_KEYS
+
+    t0 = time.time()
+    findings: list[Finding] = []
+    declared = set(EVENT_KEYS)
+
+    with open(os.path.join(REPO_ROOT, EVENTS_MODULE)) as fh:
+        events_src = fh.read()
+    literals = branch_literals(events_src)
+    for lit, line in sorted(literals.items()):
+        if lit not in declared:
+            findings.append(Finding(
+                PASS_ID, "error", EVENTS_MODULE, line,
+                f"validator branch tests event type '{lit}' which "
+                f"DECLARED_EVENTS no longer declares — stale branch "
+                f"reads as coverage",
+                {"type": lit, "declared": sorted(declared)},
+            ))
+
+    with open(os.path.join(REPO_ROOT, SCHEMA_SCRIPT)) as fh:
+        script_src = fh.read()
+    doc = ast.get_docstring(ast.parse(script_src)) or ""
+    for t in missing_doc_mentions(doc, declared):
+        findings.append(Finding(
+            PASS_ID, "warning", SCHEMA_SCRIPT, 1,
+            f"declared event type '{t}' is never mentioned in the "
+            f"schema contract doc — the published contract silently "
+            f"under-promises",
+            {"type": t},
+        ))
+
+    checked = len(declared) + len(literals)
+    notes = [f"{len(declared)} declared types vs {len(literals)} "
+             f"validator branches + contract doc"]
+    return PassResult(PASS_ID, findings, checked, time.time() - t0, notes)

@@ -1,0 +1,143 @@
+"""Guard-purity pass: every model's DCE-derived guard pass writes no
+W-wide successor rows and reads state only through declared layout
+fields.
+
+Generalizes the single ``tests/test_expand_sparse.py`` jaxpr pin to a
+registry-wide audit. The guard-first sparse expansion exists so the
+per-chunk guard grid costs O(A) scalars per state instead of
+materializing the [A, W] successor block; a refactor of ``_expand1``
+that lets a successor write survive DCE silently reverts the split's
+entire win. Three checks per family, on ``model.guards1.jaxpr``:
+
+  * no equation output is a ``[*, W]`` block (ndim >= 2 with a W-sized
+    trailing axis) — single [W] vectors are fine, the input state is
+    one;
+  * DCE actually removed work — the guard jaxpr is strictly smaller
+    than the full ``_expand1`` jaxpr;
+  * every static slice of the state vector falls inside ONE declared
+    layout field span (guards read whole lanes of declared fields;
+    a slice straddling fields means the guard is reading a lane the
+    layout registry does not declare at that offset). Reads through
+    gathers or of the whole state vector are conservatively allowed.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .findings import Finding, PassResult, site_of
+
+PASS_ID = "guard-purity"
+
+# the hook the mutation self-test overrides (a fresh, never-cached
+# model with a poisoned guard derivation) — production resolves through
+# the registry's shared cached_model instances
+def _default_model(fam: str):
+    from . import registry
+
+    return registry.tiny_model(fam)
+
+
+MODEL_FN = _default_model
+
+
+def _state_slices(jaxpr, state_var):
+    """Static (start, limit) spans sliced out of the state vector, plus
+    a flag for non-slice reads (gather/dynamic_slice/whole-vector use)
+    that the span check cannot see through."""
+    spans = []
+    opaque = False
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if v is not state_var:
+                continue
+            if str(eqn.primitive) == "slice":
+                spans.append((
+                    int(eqn.params["start_indices"][0]),
+                    int(eqn.params["limit_indices"][0]),
+                ))
+            else:
+                opaque = True
+    return spans, opaque
+
+
+def check_model(fam: str, model, findings: list) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    checked = 0
+    W = model.layout.W
+    path, line = site_of(type(model)._build_guards1)
+    jx = model.guards1.jaxpr
+
+    checked += 1
+    wide = [
+        (str(e.primitive), tuple(v.aval.shape))
+        for e in jx.eqns
+        for v in e.outvars
+        if getattr(v.aval, "ndim", 0) >= 2 and v.aval.shape[-1] == W
+    ]
+    if wide:
+        findings.append(Finding(
+            PASS_ID, "error", path, line,
+            f"{fam}: guard jaxpr materializes W-wide successor rows — "
+            f"the sparse split's whole point is to never build these "
+            f"in the guard pass",
+            {"family": fam, "w": W,
+             "eqns": [f"{p} -> {s}" for p, s in wide]},
+        ))
+
+    checked += 1
+    full = jax.make_jaxpr(model._expand1)(
+        jax.ShapeDtypeStruct((W,), jnp.int32)).jaxpr
+    if not len(jx.eqns) < len(full.eqns):
+        findings.append(Finding(
+            PASS_ID, "error", path, line,
+            f"{fam}: DCE removed nothing from the guard jaxpr "
+            f"({len(jx.eqns)} eqns vs full {len(full.eqns)}) — the "
+            f"guard pass is doing the apply pass's work",
+            {"family": fam, "guard_eqns": len(jx.eqns),
+             "full_eqns": len(full.eqns)},
+        ))
+
+    # read-lane discipline: static state slices sit inside one field
+    checked += 1
+    state_var = jx.invars[-1] if jx.invars else None
+    spans_decl = sorted(
+        (f.offset, f.offset + f.size) for f in model.layout.fields.values()
+    )
+    if state_var is not None and getattr(
+            state_var.aval, "shape", None) == (W,):
+        spans, _opaque = _state_slices(jx, state_var)
+        for start, limit in spans:
+            inside = any(
+                lo <= start and limit <= hi for lo, hi in spans_decl)
+            if not inside:
+                findings.append(Finding(
+                    PASS_ID, "error", path, line,
+                    f"{fam}: guard reads state lanes [{start}:{limit}) "
+                    f"which straddle the declared layout fields — the "
+                    f"layout registry declares no field at that span",
+                    {"family": fam, "span": [start, limit]},
+                ))
+    return checked
+
+
+def run(families=None) -> PassResult:
+    from . import registry
+
+    t0 = time.time()
+    families = tuple(families) if families else registry.FAMILIES
+    findings: list[Finding] = []
+    checked = 0
+    skipped = []
+    for fam in families:
+        model = MODEL_FN(fam)
+        if not hasattr(model, "_build_guards1"):
+            skipped.append(fam)
+            continue
+        checked += check_model(fam, model, findings)
+    notes = [f"guard jaxprs of {len(families) - len(skipped)} families"]
+    if skipped:
+        notes.append(f"skipped (no sparse guard pass): {skipped}")
+    return PassResult(PASS_ID, findings, checked, time.time() - t0, notes)

@@ -1,0 +1,183 @@
+"""Lane-discipline pass: fleet-packable constants are read through the
+``_cv`` lane indirection, and ACTION_NAMES stays lock-stepped with each
+module's rank table.
+
+Fleet packing (fleet/grouping.py FLEET_DYN) compiles ONE program for a
+whole grid of CONSTANTS bindings by routing each dynamic constant
+through a per-state lane: guards call ``self._cv(d, "max_restarts")``,
+which reads the ``c_max_restarts`` lane when the layout packs one and
+falls back to the scalar param otherwise. A guard that reads
+``self.p.max_restarts`` (or the params property) directly compiles the
+constant INTO the program — every job in a packed fleet group then
+silently checks the first job's bound, with no shape error to catch it.
+This pass AST-scans the FLEET_DYN model modules and flags any attribute
+read of a dynamic-constant name inside a function that receives packed
+state (a ``d``/``states`` argument).
+
+The second contract is the coverage registry lock-step (migrated from
+tests/test_action_coverage.py): each model module's widest
+``(R_A, R_B, ...) = range(N)`` rank unpack, plus its extension
+constants, must agree with ``len(ACTION_NAMES)`` — a new Next disjunct
+without a name breaks coverage attribution silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import time
+
+from .findings import Finding, PassResult, rel
+
+PASS_ID = "lane-discipline"
+
+# the hook the mutation self-test overrides: {rel_path: source_text}
+SOURCE_OVERRIDES: dict | None = None
+
+# params-class -> module resolution for FLEET_DYN (grouping keys params
+# classes; the guards live in the model modules)
+_DYN_MODULES = {"RaftParams": "raft", "PullRaftParams": "pull_raft"}
+
+
+def module_max_rank(src: str) -> int | None:
+    """Highest action rank a model module declares, read from source:
+    the widest ``(R_A, ...) = range(N)`` unpack (>= 10 targets, the
+    Next-disjunct order) extended by later constant assigns whose
+    values continue the numbering."""
+    n_base = None
+    extras: list[int] = []
+    for node in ast.parse(src).body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        if (
+            isinstance(tgt, ast.Tuple) and len(tgt.elts) >= 10
+            and isinstance(val, ast.Call)
+            and isinstance(val.func, ast.Name) and val.func.id == "range"
+            and len(val.args) == 1
+            and isinstance(val.args[0], ast.Constant)
+        ):
+            n_base = int(val.args[0].value)
+            if len(tgt.elts) != n_base:
+                return None  # arity mismatch: reported by the caller
+            extras = []
+        elif (
+            n_base is not None
+            and isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple)
+            and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in val.elts
+            )
+        ):
+            vals = [int(e.value) for e in val.elts]
+            if vals and min(vals) >= n_base:
+                extras += vals
+    if n_base is None:
+        return None
+    return max([n_base - 1, *extras])
+
+
+def _packed_state_functions(tree: ast.Module):
+    """FunctionDefs (at any nesting) that touch packed state — a ``d``
+    or ``states`` argument, or a ``d = self._dec(...)``-style local
+    decode — i.e. the fleet-packable guard/apply surface where
+    constants must route through ``_cv``."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        names = {a.arg for a in node.args.args}
+        if "d" in names or "states" in names:
+            yield node
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and sub.targets[0].id in ("d", "states")
+                    and isinstance(sub.value, ast.Call)):
+                yield node
+                break
+
+
+def scan_dyn_consts(src: str, path: str, dyn_names, findings: list) -> int:
+    """Flag raw attribute reads of dynamic-constant names inside
+    packed-state functions; returns functions audited. The compliant
+    spelling passes the name as a STRING to ``_cv``/``_cv_batch``, so
+    any ``<expr>.max_restarts`` attribute inside such a function is a
+    compiled-in constant."""
+    tree = ast.parse(src)
+    audited = 0
+    for fn in _packed_state_functions(tree):
+        audited += 1
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in dyn_names):
+                findings.append(Finding(
+                    PASS_ID, "error", path, node.lineno,
+                    f"{fn.name}() reads dynamic constant "
+                    f"'{node.attr}' as an attribute — in a packed "
+                    f"fleet group every job would check the compiled "
+                    f"job's bound; route it through "
+                    f"self._cv(d, \"{node.attr}\")",
+                    {"function": fn.name, "constant": node.attr},
+                ))
+    return audited
+
+
+def _module_source(mod_name: str) -> tuple[str, str]:
+    relpath = os.path.join("raft_tpu", "models", f"{mod_name}.py")
+    if SOURCE_OVERRIDES and relpath in SOURCE_OVERRIDES:
+        return SOURCE_OVERRIDES[relpath], relpath
+    mod = importlib.import_module(f"raft_tpu.models.{mod_name}")
+    with open(mod.__file__) as fh:
+        return fh.read(), rel(mod.__file__)
+
+
+def run() -> PassResult:
+    from ..fleet.grouping import FLEET_DYN
+    from . import registry
+
+    t0 = time.time()
+    findings: list[Finding] = []
+    checked = 0
+
+    # _cv discipline over the fleet-packable modules
+    for cls_name, dyn_names in sorted(FLEET_DYN.items()):
+        mod_name = _DYN_MODULES.get(cls_name)
+        if mod_name is None:
+            findings.append(Finding(
+                PASS_ID, "warning", "raft_tpu/fleet/grouping.py", 1,
+                f"FLEET_DYN class {cls_name} has no known model module "
+                f"— the lane-discipline audit cannot see its guards",
+                {"class": cls_name},
+            ))
+            continue
+        src, path = _module_source(mod_name)
+        checked += scan_dyn_consts(src, path, set(dyn_names), findings)
+
+    # ACTION_NAMES lock-step across every model module
+    for mod_name in registry.MODEL_MODULES:
+        checked += 1
+        src, path = _module_source(mod_name)
+        max_rank = module_max_rank(src)
+        mod = importlib.import_module(f"raft_tpu.models.{mod_name}")
+        names = getattr(mod, "ACTION_NAMES", None)
+        if max_rank is None or names is None:
+            findings.append(Finding(
+                PASS_ID, "error", path, 1,
+                f"{mod_name}: no rank table / ACTION_NAMES found — the "
+                f"coverage registry contract expects both",
+                {"module": mod_name},
+            ))
+        elif len(names) != max_rank + 1:
+            findings.append(Finding(
+                PASS_ID, "error", path, 1,
+                f"{mod_name}: {len(names)} ACTION_NAMES for declared "
+                f"ranks 0..{max_rank} — coverage attribution breaks "
+                f"silently on the drifted ranks",
+                {"module": mod_name, "names": len(names),
+                 "max_rank": max_rank},
+            ))
+    notes = [f"{len(registry.MODEL_MODULES)} modules lock-step, "
+             f"{len(FLEET_DYN)} packable families"]
+    return PassResult(PASS_ID, findings, checked, time.time() - t0, notes)

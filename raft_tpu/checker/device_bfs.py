@@ -101,6 +101,25 @@ class DeviceBFS:
     OVF_NAMES = ((1, "msg"), (2, "valid"), (4, "frontier"), (8, "journal"))
     SEEN_OVF_BIT = 16
 
+    # Donation contract for the wave/chunk programs: argument indices of
+    # the capacity-shaped loop carries updated in place every dispatch
+    # (next_buf, jparent, jcand, viol, stats, memo, cov). The frontier
+    # (argnum 0) is deliberately NOT donated — the host swaps it with
+    # next_buf between waves. analysis/donation.py verifies the lowered
+    # programs alias exactly these, so an edit that drops one is named
+    # before it costs a per-wave buffer copy.
+    WAVE_DONATE = (1, 2, 3, 4, 5, 6, 7)
+    CHUNK_DONATE = (1, 2, 3, 4, 5, 6, 7)
+    # --timeline stage programs: memo in canon; the six state carries in
+    # finish; stats in the reset (expand/dedup carry nothing)
+    TL_DONATE = {
+        "expand": (),
+        "canon": (2,),
+        "dedup": (),
+        "finish": (0, 1, 2, 3, 4, 5),
+        "statreset": (0,),
+    }
+
     def __init__(
         self,
         model,
@@ -195,12 +214,14 @@ class DeviceBFS:
         self._memo = CanonMemo(canon_memo_cap if self._use_memo else 1)
         self.MCAP = self._memo.MCAP
         # donated: next_buf, jparent, jcand, viol, stats, memo, cov
-        # (seen read-only)
+        # (seen read-only; the donation sets are class attributes so the
+        # static donation auditor — analysis/donation.py — can verify
+        # the lowered aliasing against CARRY_NAMES independently)
         self._chunk_fn = jax.jit(
-            self._chunk_step, donate_argnums=(1, 2, 3, 4, 5, 6, 7)
+            self._chunk_step, donate_argnums=self.CHUNK_DONATE
         )
         self._wave_fn = jax.jit(
-            self._wave_step, donate_argnums=(1, 2, 3, 4, 5, 6, 7)
+            self._wave_step, donate_argnums=self.WAVE_DONATE
         )
         self._flag_true = jnp.asarray(True)
         self._flag_false = jnp.asarray(False)
@@ -260,13 +281,16 @@ class DeviceBFS:
         self._seen = fn(self._seen, *ladder)
         self._seen_real = new_real
 
-    def _make_seen_merge(self, key):
-        """Build (and compile+probe, via jit_with_donation) the merge
-        program for one (seen size, ladder shapes, target) signature.
-        All inputs are donated: the old seen run and the wave ladder are
-        dead after the merge, so on backends that alias donations the
-        multi-million-lane sort reuses their HBM instead of holding
-        old + new + scratch live at once."""
+    @staticmethod
+    def _seen_merge_spec(key):
+        """(body, donate_argnums) of the merge program for one
+        (seen size, ladder shapes, target) signature — the single source
+        both the production wrapper below and the static donation /
+        signature auditors build from. All inputs are donated: the old
+        seen run and the wave ladder are dead after the merge. The
+        pad-up branch keeps the output EXACTLY ``target`` lanes even
+        when the concat total falls short — the signature-closure
+        invariant (_merge_seen) depends on it."""
         size, lshapes, target = key
         total = size + sum(lshapes)
 
@@ -278,9 +302,18 @@ class DeviceBFS:
                 )
             return out
 
+        return merge, tuple(range(1 + len(lshapes)))
+
+    def _make_seen_merge(self, key):
+        """Build (and compile+probe, via jit_with_donation) the merge
+        program for one signature: on backends that alias donations the
+        multi-million-lane sort reuses the dead inputs' HBM instead of
+        holding old + new + scratch live at once."""
+        size, lshapes, _target = key
+        merge, donate = self._seen_merge_spec(key)
         return jit_with_donation(
             merge,
-            tuple(range(1 + len(lshapes))),
+            donate,
             lambda: tuple(
                 jnp.full((n,), U64_MAX, jnp.uint64) for n in (size, *lshapes)
             ),
@@ -632,17 +665,18 @@ class DeviceBFS:
         _run_timeline_wave rebinds every donated carry from the stage
         return, so the dead inputs are never touched again."""
         if self._tl_fns is None:
+            d = self.TL_DONATE
             self._tl_fns = {
                 "expand": jax.jit(self._st_expand),
-                "canon": jax.jit(self._st_canon, donate_argnums=(2,)),
+                "canon": jax.jit(self._st_canon, donate_argnums=d["canon"]),
                 "dedup": jax.jit(self._st_dedup),
                 "finish": jax.jit(
-                    self._st_finish, donate_argnums=(0, 1, 2, 3, 4, 5)
+                    self._st_finish, donate_argnums=d["finish"]
                 ),
                 "statreset": jax.jit(
                     lambda s: s * jnp.asarray([0, 1, 1, 1, 0, 1],
                                               dtype=s.dtype),
-                    donate_argnums=(0,),
+                    donate_argnums=d["statreset"],
                 ),
             }
         return self._tl_fns
@@ -701,6 +735,7 @@ class DeviceBFS:
         n_chunks = -(-int(fcount) // C)
         for k in range(n_chunks):
             t = pc()
+            # lint: sync-ok(stage attribution on a sampled wave)
             ex = jax.block_until_ready(
                 fns["expand"](frontier, np.int32(k * C), np.int32(fcount))
             )
@@ -708,16 +743,19 @@ class DeviceBFS:
             (flatc, sel, selv, valid, rank, n_gen, terminal, e_ovf,
              c_ovf) = ex
             t = pc()
+            # lint: sync-ok(stage attribution on a sampled wave)
             fps, memo, n_memo_hit = jax.block_until_ready(
                 fns["canon"](flatc, selv, memo)
             )
             stage_s["canon"] += pc() - t
             t = pc()
+            # lint: sync-ok(stage attribution on a sampled wave)
             new = jax.block_until_ready(
                 fns["dedup"](fps, occ_all, self._seen, *ladder)
             )
             stage_s["dedup"] += pc() - t
             t = pc()
+            # lint: sync-ok(stage attribution on a sampled wave)
             (next_buf, jparent, jcand, viol, stats, cov,
              new_run) = jax.block_until_ready(fns["finish"](
                 next_buf, jparent, jcand, viol, stats, cov, flatc, fps,
@@ -741,7 +779,7 @@ class DeviceBFS:
             for i in range(tt):
                 ladder[i] = reset_run(i)
             ladder[tt] = merged
-            jax.block_until_ready(ladder)
+            jax.block_until_ready(ladder)  # lint: sync-ok(stage attribution)
             stage_s["seen_merge"] += pc() - t
         return (next_buf, jparent, jcand, viol, stats, memo, cov, *ladder)
 
@@ -763,35 +801,178 @@ class DeviceBFS:
         with tel.annotate("precompile"):
             self._precompile_programs()
 
-    def _precompile_programs(self) -> None:
-        W = self.W
+    def signature_inventory(self):
+        """The FINITE signature universe a run at the CURRENT capacities
+        dispatches, in precompile order: a ``("wave", seen_size)`` per
+        seen-ladder size, each followed by the per-wave seen merges that
+        size can need — ``("merge", size, lshapes, target)`` for every
+        ladder target >= size. ``_precompile_programs`` warms exactly
+        this set; analysis/signatures.py independently recomputes the
+        reachable set from the geometry primitives (_seen_size_for, the
+        wave ladder, the pad-up merge contract) and proves the two are
+        equal — the BENCH_r05 retrace-cliff class, checked symbolically.
+        """
         K = self._wave_geom()
         lshapes = tuple((self.R0 << i) for i in range(K + 1))
-        frontier = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
         for si, size in enumerate(self._seen_sizes):
-            seen = jnp.full((size,), U64_MAX, jnp.uint64)
-            next_buf = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
-            jparent = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
-            jcand = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
-            viol = jnp.full((max(1, len(self.invariants)),), I32_MAX, jnp.int32)
-            stats = jnp.zeros((6,), jnp.int64)
-            cov = jnp.zeros((self.n_actions, 3), jnp.int64)
-            self._wave_fn(
-                frontier, next_buf, jparent, jcand, viol, stats,
-                self._memo.reset(), cov,
-                np.int32(0), np.int32(0), self._occ_one, seen,
-            )
-            # per-wave seen merges this size can need (targets >= size;
-            # one wave adds at most pow2(FCAP) real lanes, so targets
-            # further than two ladder steps up are unreachable).
+            yield ("wave", size)
+            # targets >= size only: one wave adds at most pow2(FCAP)
+            # real lanes, so targets further than two ladder steps up
+            # are unreachable — but warming the whole upper triangle is
+            # cheap and keeps the closure argument one-sided
+            for target in self._seen_sizes[si:]:
+                yield ("merge", size, lshapes, target)
+
+    def _precompile_programs(self) -> None:
+        W = self.W
+        frontier = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
+        for sig in self.signature_inventory():
+            if sig[0] == "wave":
+                size = sig[1]
+                seen = jnp.full((size,), U64_MAX, jnp.uint64)
+                next_buf = jnp.zeros((self.FCAP + self.VC, W), jnp.int32)
+                jparent = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
+                jcand = jnp.zeros((self.JCAP + self.VC,), jnp.int32)
+                viol = jnp.full(
+                    (max(1, len(self.invariants)),), I32_MAX, jnp.int32
+                )
+                stats = jnp.zeros((6,), jnp.int64)
+                cov = jnp.zeros((self.n_actions, 3), jnp.int64)
+                self._wave_fn(
+                    frontier, next_buf, jparent, jcand, viol, stats,
+                    self._memo.reset(), cov,
+                    np.int32(0), np.int32(0), self._occ_one, seen,
+                )
+                continue
             # _make_seen_merge compiles AND executes each program once
             # (its donation probe) on fresh throwaway buffers — the
-            # cached merges above must never be handed shared arrays,
-            # since a successful donation consumes its inputs.
-            for target in self._seen_sizes[si:]:
-                key = (size, lshapes, target)
-                if key not in self._merge_cache:
-                    self._merge_cache[key] = self._make_seen_merge(key)
+            # cached merges must never be handed shared arrays, since a
+            # successful donation consumes its inputs.
+            key = sig[1:]
+            if key not in self._merge_cache:
+                self._merge_cache[key] = self._make_seen_merge(key)
+
+    # ---------------- static audit surface ----------------
+
+    def audit_programs(self):
+        """Every device program this engine dispatches, as audit entries
+        for the static donation auditor (analysis/donation.py):
+
+          name     program id (``wave`` / ``tl:<stage>`` / ``seen_merge``)
+          fn       a ``.lower()``-able jitted callable — the PRODUCTION
+                   jit object where one exists
+          args     abstract arguments for ``fn.lower(*args)``
+          carries  {argnum: name} of the capacity-shaped loop carries
+                   that MUST alias an output in the lowered program
+          pinned   {argnum: name} of buffers that must NOT be donated
+                   (the host reuses them after the dispatch)
+          site     (file, line) anchor for findings
+          per_wave dispatches per wave (scales the bytes-copied cost of
+                   a donation miss)
+
+        Yields entries without lowering or executing anything — tracing
+        is the caller's cost, so passes choose their own coverage. The
+        ``carries`` maps are written out independently of the
+        ``*_DONATE`` declarations on purpose: the auditor compares the
+        lowered aliasing against THIS list, so dropping an argnum from a
+        donate tuple (the classic regression) diverges the two.
+        """
+        import inspect as _inspect
+
+        sds = jax.ShapeDtypeStruct
+        W, K = self.W, self._wave_geom()
+        i32s = sds((), np.int32)
+        frontier = sds((self.FCAP + self.VC, W), jnp.int32)
+        next_buf = sds((self.FCAP + self.VC, W), jnp.int32)
+        jparent = sds((self.JCAP + self.VC,), jnp.int32)
+        jcand = sds((self.JCAP + self.VC,), jnp.int32)
+        viol = sds((max(1, len(self.invariants)),), jnp.int32)
+        stats = sds((6,), jnp.int64)
+        memo = sds((self.MCAP, 2), jnp.uint64)
+        cov = sds((self.n_actions, 3), jnp.int64)
+        occ = sds((1,), jnp.bool_)
+        seen = sds((self._seen_sizes[0],), jnp.uint64)
+        wave_carries = {
+            1: "next_buf", 2: "jparent", 3: "jcand", 4: "viol",
+            5: "stats", 6: "memo", 7: "cov",
+        }
+
+        def site(fn):
+            f = _inspect.unwrap(fn)
+            return (__file__, _inspect.getsourcelines(f)[1])
+
+        yield {
+            "name": "wave", "fn": self._wave_fn,
+            "args": (frontier, next_buf, jparent, jcand, viol, stats,
+                     memo, cov, i32s, i32s, occ, seen),
+            "carries": dict(wave_carries),
+            "pinned": {0: "frontier"},
+            "site": site(self._wave_step), "per_wave": 1,
+        }
+        # NOTE: _chunk_fn (the unfused per-chunk program) shares the
+        # donate set but has not been dispatched since the wave fusion
+        # (round 5); it is omitted here so the auditor's lowering budget
+        # goes to programs a run actually executes.
+
+        # --timeline stage programs: chain abstract shapes through the
+        # stage methods with eval_shape (no tracing of the jitted
+        # wrappers until the auditor lowers them)
+        fns = self._tl_programs()
+        ex_out = jax.eval_shape(self._st_expand, frontier, i32s, i32s)
+        flatc, sel, selv = ex_out[0], ex_out[1], ex_out[2]
+        valid, rank = ex_out[3], ex_out[4]
+        n_gen, terminal, e_ovf, c_ovf = ex_out[5:9]
+        canon_out = jax.eval_shape(self._st_canon, flatc, selv, memo)
+        fps = canon_out[0]
+        n_memo_hit = canon_out[2]
+        occ_all = sds((K + 2,), jnp.bool_)
+        ladder = tuple(
+            sds((self.R0 << i,), jnp.uint64) for i in range(K + 1)
+        )
+        new = jax.eval_shape(
+            self._st_dedup, fps, occ_all, seen, *ladder
+        )
+        yield {
+            "name": "tl:canon", "fn": fns["canon"],
+            "args": (flatc, selv, memo),
+            "carries": {2: "memo"}, "pinned": {},
+            "site": site(self._st_canon), "per_wave": 1,
+        }
+        yield {
+            "name": "tl:finish", "fn": fns["finish"],
+            "args": (next_buf, jparent, jcand, viol, stats, cov, flatc,
+                     fps, sel, valid, rank, new, n_gen, terminal, e_ovf,
+                     c_ovf, n_memo_hit, i32s, i32s),
+            "carries": {0: "next_buf", 1: "jparent", 2: "jcand",
+                        3: "viol", 4: "stats", 5: "cov"},
+            "pinned": {},
+            "site": site(self._st_finish), "per_wave": 1,
+        }
+        yield {
+            "name": "tl:statreset", "fn": fns["statreset"],
+            "args": (stats,),
+            "carries": {0: "stats"}, "pinned": {},
+            "site": site(self._tl_programs), "per_wave": 1,
+        }
+        # the per-wave seen merge, at the first (size, target) signature:
+        # spec-built jit (production wraps the same body through the
+        # jit_with_donation backend probe)
+        key = (self._seen_sizes[0],
+               tuple((self.R0 << i) for i in range(K + 1)),
+               self._seen_sizes[0])
+        body, donate = self._seen_merge_spec(key)
+        merge_args = tuple(
+            sds((n,), jnp.uint64) for n in (key[0], *key[1])
+        )
+        yield {
+            "name": "seen_merge",
+            "fn": jax.jit(body, donate_argnums=donate),
+            "args": merge_args,
+            "carries": {0: "seen",
+                        **{1 + i: f"ladder[{i}]" for i in range(K + 1)}},
+            "pinned": {},
+            "site": site(self._seen_merge_spec), "per_wave": 1,
+        }
 
     # ---------------- capacity growth ----------------
 
@@ -1107,6 +1288,7 @@ class DeviceBFS:
                 # device_gets double the tunnel RTT on small configs,
                 # where per-wave latency dominates) — and telemetry
                 # rides this same snapshot
+                # lint: sync-ok(once-per-wave snapshot)
                 stats_h, viol_h, cov_w = jax.device_get((stats, viol, cov))
             device_s = time.perf_counter() - tw
             stats_h = np.asarray(stats_h)

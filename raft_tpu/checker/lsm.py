@@ -136,6 +136,26 @@ class RunLSM:
             self._merge_cache[key] = fn
         return fn
 
+    @staticmethod
+    def merge_spec(out: int | None = None):
+        """The merge program SPEC at a (na, nb, out) signature: the
+        traced body plus its donate argnums, before any backend probing.
+        The static donation auditor (analysis/donation.py) lowers
+        ``jax.jit(body, donate_argnums=donate)`` from this spec — the
+        production ``_merge`` wraps the same body through the
+        jit_with_donation probe, which may silently fall back to an
+        undonated jit on backends that cannot alias (so auditing the
+        probed object would prove the wrong thing)."""
+        if out is None:
+            def body(x, y):
+                return sort_u64(jnp.concatenate([x, y], axis=-1), axis=-1)
+        else:
+            def body(x, y):
+                return sort_u64(
+                    jnp.concatenate([x, y], axis=-1), axis=-1
+                )[..., :out]
+        return body, (0, 1)
+
     def _merge(self, a, b, out: int | None = None):
         """Per-row sort-concat merge along the lane axis (2-key u32 sort:
         a u64 lax.sort is ~300x slower on this TPU, ops/hashing.py).
@@ -151,21 +171,48 @@ class RunLSM:
         fn = self._merge_cache.get(key)
         if fn is None:
             na, nb = a.shape[-1], b.shape[-1]
-            if out is None:
-                def body(x, y):
-                    return sort_u64(jnp.concatenate([x, y], axis=-1), axis=-1)
-            else:
-                def body(x, y):
-                    return sort_u64(
-                        jnp.concatenate([x, y], axis=-1), axis=-1
-                    )[..., :out]
+            body, donate = self.merge_spec(out)
             fn = jit_with_donation(
-                body, (0, 1),
+                body, donate,
                 lambda: (self._fresh(na), self._fresh(nb)),
                 **self._jit_kw,
             )
             self._merge_cache[key] = fn
         return fn(a, b)
+
+    # ---------------- static audit surface ----------------
+
+    def audit_programs(self):
+        """The cascade's complete merge-signature set (the same closure
+        argument as ``warmup``: carries double exactly, so only
+        equal-size merges per level plus the top truncate-merge exist),
+        as audit entries for the static donation auditor — same schema
+        as the engines' ``audit_programs``. ``_pad_run`` is absent by
+        policy: its output is strictly larger than its input, so
+        aliasing is impossible and the program is exempt from the
+        donation contract."""
+        import inspect as _inspect
+
+        sds = jax.ShapeDtypeStruct
+        _, line = _inspect.getsourcelines(RunLSM.merge_spec)
+        site = (__file__, line)
+        for i in range(len(self.runs)):
+            size = self.lv_size(i)
+            top = size >= self.TOPSZ
+            body, donate = self.merge_spec(size if top else None)
+            run = sds(self._lead + (size,), jnp.uint64)
+            yield {
+                "name": (f"lsm_merge[L{i}:top]" if top
+                         else f"lsm_merge[L{i}]"),
+                "fn": jax.jit(body, donate_argnums=donate,
+                              **self._jit_kw),
+                "args": (run, run),
+                "carries": {0: "run_a", 1: "run_b"},
+                "pinned": {},
+                "site": site, "per_wave": 1,
+            }
+            if top:
+                break
 
     def _pad_run(self, run, size: int):
         have = run.shape[-1]

@@ -139,6 +139,22 @@ class ShardedBFS:
     )
     SEEN_OVF_BIT = 32
 
+    # Donation contract (audited by `raft_tpu lint`, pass `donation`):
+    # every capacity-shaped per-wave carry must alias an output of the
+    # program that rebinds it. The frontier is read-only within a wave
+    # (host-swapped with next_buf at the wave boundary), fc/bl/cursor are
+    # scalars-per-shard, and occ plus the LSM runs are reused across
+    # chunks — none of those donate.
+    #   chunk: next_buf, jps, jpl, jcand, jfp, viol, stats, memo, cov
+    CHUNK_DONATE = (2, 3, 4, 5, 6, 7, 8, 9, 10)
+    # timeline stages (--timeline sampled waves): memo through pre, the
+    # routed payloads through exchange, the state carries through post
+    TL_DONATE = {
+        "pre": (2,),
+        "exchange": (0, 1),
+        "post": (2, 3, 4, 5, 6, 7, 8, 9),
+    }
+
     def __init__(
         self,
         model,
@@ -277,9 +293,7 @@ class ShardedBFS:
                     out_specs=(spec,) * 10,
                     **_SHARD_MAP_KW,
                 ),
-                # donated: next_buf, jps, jpl, jcand, jfp, viol, stats,
-                # memo, cov
-                donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10),
+                donate_argnums=self.CHUNK_DONATE,
             )
             self._chunk_fn_cache[n_runs] = fn
         return fn
@@ -317,12 +331,12 @@ class ShardedBFS:
                     pre_step, mesh=self.mesh,
                     in_specs=(spec, spec, spec, P(), spec),
                     out_specs=(spec,) * 5, **_SHARD_MAP_KW,
-                ), donate_argnums=(2,)),
+                ), donate_argnums=self.TL_DONATE["pre"]),
                 jax.jit(_shard_map(
                     ex_step, mesh=self.mesh,
                     in_specs=(spec, spec), out_specs=(spec, spec),
                     **_SHARD_MAP_KW,
-                ), donate_argnums=(0, 1)),
+                ), donate_argnums=self.TL_DONATE["exchange"]),
             )
         post_fn = self._tl_post_cache.get(n_runs)
         if post_fn is None:
@@ -344,9 +358,92 @@ class ShardedBFS:
                 post_step, mesh=self.mesh,
                 in_specs=(spec,) * 12 + (P(),) + (spec,) * n_runs,
                 out_specs=(spec,) * 9, **_SHARD_MAP_KW,
-            ), donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+            ), donate_argnums=self.TL_DONATE["post"])
             self._tl_post_cache[n_runs] = post_fn
         return self._tl_pre_ex[0], self._tl_pre_ex[1], post_fn
+
+    # ---------------- static audit surface ----------------
+
+    def audit_programs(self):
+        """Every device program a sharded run dispatches, as audit
+        entries for the static donation auditor (analysis/donation.py) —
+        the same entry schema as ``DeviceBFS.audit_programs``: ``fn`` is
+        a ``.lower()``-able jitted callable (the production jit object),
+        ``args`` its abstract arguments, ``carries``/``pinned`` the
+        independent {argnum: name} donation declarations the auditor
+        compares against the lowered aliasing, ``site`` a (file, line)
+        anchor, ``per_wave`` the dispatch count per wave. Nothing is
+        lowered or executed here; the ``carries`` maps are deliberately
+        written out separately from ``CHUNK_DONATE``/``TL_DONATE`` so a
+        dropped donate argnum diverges the two."""
+        import inspect as _inspect
+
+        sds = jax.ShapeDtypeStruct
+        D, W = self.D, self.W
+        n_runs = len(self._lsm.runs)
+        i32s = sds((), np.int32)
+        frontier = sds((D, self.FCAP + self.EPAD, W), jnp.int32)
+        next_buf = sds((D, self.FCAP + self.EPAD, W), jnp.int32)
+        fc = sds((D, 1), jnp.int32)
+        bl = sds((D, 1), jnp.int32)
+        jps = sds((D, self.JCAP + self.EPAD), jnp.int32)
+        jpl = sds((D, self.JCAP + self.EPAD), jnp.int32)
+        jcand = sds((D, self.JCAP + self.EPAD), jnp.int32)
+        jfp = sds((D, self.JCAP + self.EPAD), jnp.uint64)
+        viol = sds((D, max(1, len(self.invariants))), jnp.int32)
+        stats = sds((D, 7), jnp.int64)
+        memo = sds((D, self.MCAP, 2), jnp.uint64)
+        cov = sds((D, self.n_actions, 3), jnp.int64)
+        occ = sds((n_runs,), jnp.bool_)
+        runs = tuple(
+            sds((D, self._lsm.lv_size(i)), jnp.uint64)
+            for i in range(n_runs)
+        )
+
+        def site(fn):
+            f = _inspect.unwrap(fn)
+            return (__file__, _inspect.getsourcelines(f)[1])
+
+        yield {
+            "name": "chunk", "fn": self._get_chunk_fn(n_runs),
+            "args": (frontier, fc, next_buf, jps, jpl, jcand, jfp, viol,
+                     stats, memo, cov, i32s, occ, bl, *runs),
+            "carries": {2: "next_buf", 3: "jps", 4: "jpl", 5: "jcand",
+                        6: "jfp", 7: "viol", 8: "stats", 9: "memo",
+                        10: "cov"},
+            "pinned": {0: "frontier"},
+            "site": site(self._chunk_step), "per_wave": 1,
+        }
+
+        # --timeline stage programs: chain abstract shapes through the
+        # jitted stages with eval_shape (free — no lowering happens
+        # until the auditor lowers an entry it chose to audit)
+        pre_fn, ex_fn, post_fn = self._get_timeline_fns(n_runs)
+        pre_out = jax.eval_shape(pre_fn, frontier, fc, memo, i32s, bl)
+        send_pay, send_fps, _memo2, cov_gen, pre_stats = pre_out
+        ex_out = jax.eval_shape(ex_fn, send_pay, send_fps)
+        recv_pay, recv_fps = ex_out
+        yield {
+            "name": "tl:pre", "fn": pre_fn,
+            "args": (frontier, fc, memo, i32s, bl),
+            "carries": {2: "memo"}, "pinned": {0: "frontier"},
+            "site": site(self._cs_pre), "per_wave": 1,
+        }
+        yield {
+            "name": "tl:exchange", "fn": ex_fn,
+            "args": (send_pay, send_fps),
+            "carries": {0: "send_pay", 1: "send_fps"}, "pinned": {},
+            "site": site(self._get_timeline_fns), "per_wave": 1,
+        }
+        yield {
+            "name": "tl:post", "fn": post_fn,
+            "args": (recv_pay, recv_fps, next_buf, jps, jpl, jcand, jfp,
+                     viol, stats, cov, cov_gen, pre_stats, occ, *runs),
+            "carries": {2: "next_buf", 3: "jps", 4: "jpl", 5: "jcand",
+                        6: "jfp", 7: "viol", 8: "stats", 9: "cov"},
+            "pinned": {},
+            "site": site(self._cs_post), "per_wave": 1,
+        }
 
     def _chunk_step(
         self, frontier, fcount, next_buf, jps, jpl, jcand, jfp, viol, stats,
@@ -1394,12 +1491,14 @@ class ShardedBFS:
                             state["frontier"], fc_dev, state["memo"],
                             np.int32(cursor), bl_dev,
                         )
+                        # lint: sync-ok(stage attribution on a sampled wave)
                         jax.block_until_ready(
                             (send_pay, send_fps, state["memo"], cov_gen,
                              pre_stats))
                         t2 = time.perf_counter()
                         stage_s["expand"] += t2 - t1
                         recv_pay, recv_fps = ex_fn(send_pay, send_fps)
+                        # lint: sync-ok(stage attribution on a sampled wave)
                         jax.block_until_ready((recv_pay, recv_fps))
                         t3 = time.perf_counter()
                         stage_s["exchange"] += t3 - t2
@@ -1413,10 +1512,12 @@ class ShardedBFS:
                             state["cov"], cov_gen, pre_stats, occ_dev,
                             *self._lsm.runs,
                         )
+                        # lint: sync-ok(stage attribution on a sampled wave)
                         jax.block_until_ready(new_run)
                         t4 = time.perf_counter()
                         stage_s["emit"] += t4 - t3
                         self._lsm.insert(new_run)
+                        # lint: sync-ok(stage attribution on a sampled wave)
                         jax.block_until_ready(self._lsm.runs)
                         stage_s["seen_merge"] += time.perf_counter() - t4
                     else:
@@ -1444,6 +1545,7 @@ class ShardedBFS:
                             # jfp lane recorded exactly those), classify,
                             # and let the supervisor reshard onto the
                             # survivors
+                            # lint: sync-ok(wave-start spill on shard loss)
                             stats_mid = np.asarray(
                                 jax.device_get(state["stats"]))
                             saved = self._abort_wave_start(
@@ -1465,6 +1567,7 @@ class ShardedBFS:
                             )
                 # cov rides the same once-per-wave fetch — no extra
                 # device_get calls with coverage on
+                # lint: sync-ok(once-per-wave snapshot)
                 stats_h, viol_h, cov_w = jax.device_get(
                     (state["stats"], state["viol"], state["cov"]))
             stats_h = np.asarray(stats_h)  # [D,7]

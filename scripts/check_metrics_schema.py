@@ -7,7 +7,10 @@ Checks every line against raft_tpu.obs.events.DECLARED_EVENTS (the same
 tuple the tier-1 smoke test pins): valid JSON per line, known event
 type, every declared key present, wave indices strictly increasing
 within a run, no wave after a run's summary, and a legal exit_cause on
-each summary. Coverage events get the structural checks on top: the
+each summary. A `stall` event (a wave exceeding the rolling-median
+wave-time factor, obs/collector.py) and a `preempt` event (SIGTERM/
+SIGINT observed, checkpoint path recorded) carry the generic known-
+type + declared-keys checks. Coverage events get the structural checks on top: the
 actions block must be [enabled, fired, new] non-negative int triples
 matching actions_total, coverage must come before the run's summary
 with non-decreasing wave indices, and the cumulative per-action

@@ -21,7 +21,6 @@ order). The invariants pinned here:
     exits 3 when an action never fired.
 """
 
-import ast
 import dataclasses
 import json
 
@@ -56,63 +55,40 @@ def _device(model, **kw):
 # ------------------------------------------------- rank/name registry
 
 
-def _module_max_rank(src: str) -> int | None:
-    """Highest action rank a model module declares, read from source.
-
-    The lowerings share one idiom: a module-level tuple unpack
-    ``(R_A, R_B, ...) = range(N)`` (the Next-disjunct order; always the
-    widest unpack in the module) optionally extended by later constant
-    assignments continuing the numbering, e.g.
-    ``R_TIMEOUT, R_ADVANCEFSYNC = 12, 13``. Smaller enums (states,
-    message types, vote results) never reach 10 targets, and extension
-    tuples below the base count (earlier enums) are ignored.
-    """
-    n_base = None
-    extras: list[int] = []
-    for node in ast.parse(src).body:
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-            continue
-        tgt, val = node.targets[0], node.value
-        if (
-            isinstance(tgt, ast.Tuple) and len(tgt.elts) >= 10
-            and isinstance(val, ast.Call)
-            and isinstance(val.func, ast.Name) and val.func.id == "range"
-            and len(val.args) == 1 and isinstance(val.args[0], ast.Constant)
-        ):
-            n_base = int(val.args[0].value)
-            assert len(tgt.elts) == n_base, "rank unpack arity mismatch"
-            extras = []
-        elif (
-            n_base is not None
-            and isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple)
-            and all(
-                isinstance(e, ast.Constant) and isinstance(e.value, int)
-                for e in val.elts
-            )
-        ):
-            vals = [int(e.value) for e in val.elts]
-            if vals and min(vals) >= n_base:
-                extras += vals
-    if n_base is None:
-        return None
-    return max([n_base - 1, *extras])
-
-
 def test_every_lowering_names_every_rank():
     """len(ACTION_NAMES) == max declared rank + 1, for every spec
     lowering — a new disjunct without a name (or a stale name list)
-    breaks coverage attribution silently otherwise."""
+    breaks coverage attribution silently otherwise.
+
+    The AST rank-table reader lives in the lane-discipline lint pass
+    now (raft_tpu.analysis.lanes.module_max_rank, the migrated
+    ``_module_max_rank``); this wrapper pins each module's table
+    against its ACTION_NAMES the way the original did."""
     import importlib
+
+    from raft_tpu.analysis.lanes import module_max_rank
 
     for name in MODEL_MODULES:
         mod = importlib.import_module(f"raft_tpu.models.{name}")
         with open(mod.__file__) as fh:
-            max_rank = _module_max_rank(fh.read())
+            max_rank = module_max_rank(fh.read())
         assert max_rank is not None, f"{name}: no rank table found"
         assert len(mod.ACTION_NAMES) == max_rank + 1, (
             f"{name}: {len(mod.ACTION_NAMES)} names for ranks "
             f"0..{max_rank}"
         )
+
+
+def test_lane_discipline_pass_clean():
+    """The full lane-discipline pass (ACTION_NAMES lock-step across the
+    registry PLUS ``_cv`` routing of fleet-dynamic constants) reports
+    nothing on the shipped tree — the superset contract of the wrapper
+    above, run exactly as ``raft_tpu lint --pass lane-discipline``."""
+    from raft_tpu.analysis import lanes
+
+    res = lanes.run()
+    assert res.checked >= len(MODEL_MODULES)
+    assert not res.findings, [f.render() for f in res.findings]
 
 
 def test_raft_instance_trims_fsync_ranks():

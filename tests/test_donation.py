@@ -39,6 +39,19 @@ def _device(**kw):
     return DeviceBFS(cached_model(TINY), invariants=INVS, symmetry=True, **kw)
 
 
+def test_static_donation_audit_clean():
+    """The static pin migrated to the donation lint pass: it lowers the
+    wave program and reads the ``tf.aliasing_output`` attributes off
+    the StableHLO ``@main`` signature, proving every declared carry
+    really aliases an output (and the pinned frontier does not) —
+    complementing the runtime ``is_deleted()`` probes below."""
+    from raft_tpu.analysis import donation
+
+    res = donation.run(families=("raft",), scopes=("device",))
+    assert res.checked > 0
+    assert not res.findings, [f.render() for f in res.findings]
+
+
 def test_device_run_emits_no_donation_warning():
     with warnings.catch_warnings():
         warnings.simplefilter("error")
